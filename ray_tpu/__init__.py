@@ -1,0 +1,59 @@
+"""ray_tpu: a TPU-native distributed AI runtime with the capabilities of Ray.
+
+Core API parity with the reference (ray: python/ray/__init__.py): tasks,
+actors, objects, placement groups — scheduled over nodes that advertise TPU
+chips and ICI topology as first-class resources; the device plane is JAX/XLA
+(pjit/shard_map over meshes, Pallas kernels) instead of CUDA/NCCL.
+"""
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import TaskError
+from ray_tpu._private.worker import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskCancelledError,
+)
+from ray_tpu.api import (
+    ActorClass,
+    ActorHandle,
+    RayContext,
+    RemoteFunction,
+    cancel,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorDiedError",
+    "ActorHandle",
+    "GetTimeoutError",
+    "ObjectRef",
+    "RayContext",
+    "RemoteFunction",
+    "TaskCancelledError",
+    "TaskError",
+    "cancel",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,104 @@
+"""Typed runtime flag table, env-overridable.
+
+Analog of the reference's RAY_CONFIG table (ray: src/ray/common/ray_config_def.h,
+205 flags overridable via RAY_* env vars). Each flag is declared once with a
+type and default; ``RAY_TPU_<NAME>`` environment variables override, and an
+explicit ``system_config`` dict (passed to ``init``) overrides both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_FLAG_DEFS: Dict[str, tuple] = {}
+
+
+def _flag(name: str, typ, default):
+    _FLAG_DEFS[name] = (typ, default)
+    return default
+
+
+class _Config:
+    """Singleton flag table. Access flags as attributes."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self.reset()
+
+    def reset(self, system_config: Dict[str, Any] | None = None):
+        self._values = {}
+        for name, (typ, default) in _FLAG_DEFS.items():
+            value = default
+            env = os.environ.get(f"RAY_TPU_{name}")
+            if env is not None:
+                value = self._parse(typ, env)
+            self._values[name] = value
+        if system_config:
+            self.update(system_config)
+
+    def update(self, overrides: Dict[str, Any]):
+        for k, v in overrides.items():
+            if k not in _FLAG_DEFS:
+                raise ValueError(f"Unknown system config flag: {k}")
+            typ, _ = _FLAG_DEFS[k]
+            self._values[k] = self._parse(typ, v) if isinstance(v, str) else typ(v)
+
+    @staticmethod
+    def _parse(typ, raw: str):
+        if typ is bool:
+            return raw.lower() in ("1", "true", "yes")
+        if typ in (dict, list):
+            return json.loads(raw)
+        return typ(raw)
+
+    def __getattr__(self, name):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+# --- flag declarations -------------------------------------------------------
+# Scheduling
+_flag("max_pending_lease_requests_per_scheduling_category", int, 10)
+_flag("scheduler_spread_threshold", float, 0.5)
+_flag("scheduler_top_k_fraction", float, 0.2)
+_flag("max_spillback_depth", int, 10)
+_flag("worker_lease_timeout_ms", int, 30_000)
+# Workers
+_flag("num_workers_soft_limit", int, 16)
+_flag("worker_register_timeout_s", float, 60.0)
+_flag("idle_worker_killing_time_threshold_ms", int, 300_000)
+_flag("prestart_worker_first_driver", bool, False)
+_flag("worker_niceness", int, 0)
+# Objects
+_flag("max_direct_call_object_size", int, 100 * 1024)  # inline threshold (ray: 100KB)
+_flag("object_store_memory", int, 2 * 1024**3)
+_flag("object_store_eviction_fraction", float, 0.8)
+_flag("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
+_flag("object_pull_timeout_s", float, 60.0)
+_flag("fetch_warn_timeout_s", float, 10.0)
+# Health / fault tolerance
+_flag("heartbeat_interval_s", float, 0.5)
+_flag("node_death_timeout_s", float, 10.0)
+_flag("gcs_rpc_timeout_s", float, 30.0)
+_flag("task_retry_delay_ms", int, 100)
+_flag("actor_restart_delay_ms", int, 100)
+# Memory monitor
+_flag("memory_usage_threshold", float, 0.95)
+_flag("memory_monitor_refresh_ms", int, 250)
+# Metrics / events
+_flag("metrics_report_interval_s", float, 2.0)
+_flag("task_events_buffer_size", int, 10_000)
+_flag("event_stats", bool, True)
+# Collective / device plane
+_flag("collective_timeout_s", float, 120.0)
+_flag("tpu_autodetect", bool, False)
+
+
+GLOBAL_CONFIG = _Config()

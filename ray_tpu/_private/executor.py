@@ -1,0 +1,238 @@
+"""Worker-side task execution.
+
+Analog of the reference's task execution path
+(ray: python/ray/_raylet.pyx:1770 task_execution_handler / :1607 execute_task
+plus ray: src/ray/core_worker/transport/actor_scheduling_queue.h): deserialize
+args (zero-copy from the shm store), run the user function on an executor
+thread (or the user asyncio loop for async actor methods), serialize returns
+(small values travel in-band back to the owner; large ones are written
+straight into the node's shm store by this process), and enforce per-caller
+sequence ordering for actor calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import object_store, serialization
+from ray_tpu._private.common import TaskSpec
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import ObjectID, TaskID
+
+logger = logging.getLogger(__name__)
+
+
+class _CallerQueue:
+    """Per-caller sequence gate (ray: sequential_actor_submit_queue.h)."""
+
+    def __init__(self):
+        self.next_seq = 0
+        self.cond = asyncio.Condition()
+
+
+class TaskExecutor:
+    def __init__(self, core_worker):
+        self.cw = core_worker
+        core_worker.executor = self
+        self.pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec"
+        )
+        self.max_concurrency = 1
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._caller_queues: Dict[bytes, _CallerQueue] = {}
+        self._user_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._user_loop_started = threading.Event()
+        self._async_sem: Optional[asyncio.Semaphore] = None
+        self.current_task_id: Optional[bytes] = None
+        self.current_job_id: Optional[bytes] = None
+
+    # ------------------------------------------------------------------
+    def _ensure_user_loop(self):
+        if self._user_loop is not None:
+            return
+        def run():
+            loop = asyncio.new_event_loop()
+            self._user_loop = loop
+            asyncio.set_event_loop(loop)
+            self._user_loop_started.set()
+            loop.run_forever()
+        threading.Thread(target=run, name="actor-async", daemon=True).start()
+        self._user_loop_started.wait()
+
+    # ------------------------------------------------------------------
+    async def become_actor(self, spec: TaskSpec):
+        try:
+            cls = cloudpickle.loads(spec.func_blob)
+            args, kwargs = await self._resolve_args(spec)
+            self.max_concurrency = max(1, spec.max_concurrency)
+            if self.max_concurrency > 1:
+                self.pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.max_concurrency, thread_name_prefix="actor-exec"
+                )
+            self.actor_spec = spec
+            self.current_job_id = spec.job_id
+            loop = asyncio.get_running_loop()
+            instance = await loop.run_in_executor(self.pool, lambda: cls(*args, **kwargs))
+            self.actor_instance = instance
+            return {}
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.error("actor init failed: %s", tb)
+            return {"error": f"{type(e).__name__}: {e}\n{tb}"}
+
+    # ------------------------------------------------------------------
+    async def execute_task(self, spec: TaskSpec):
+        is_actor_task = spec.actor_id is not None and not spec.actor_creation
+        if is_actor_task and self.max_concurrency == 1:
+            await self._await_turn(spec.caller_id, spec.seq_no)
+        try:
+            return await self._execute(spec, is_actor_task)
+        finally:
+            if is_actor_task and self.max_concurrency == 1:
+                await self._advance_turn(spec.caller_id)
+
+    async def _await_turn(self, caller_id: bytes, seq_no: int):
+        q = self._caller_queues.setdefault(caller_id, _CallerQueue())
+        async with q.cond:
+            await q.cond.wait_for(lambda: q.next_seq >= seq_no)
+
+    async def _advance_turn(self, caller_id: bytes):
+        q = self._caller_queues.setdefault(caller_id, _CallerQueue())
+        async with q.cond:
+            q.next_seq += 1
+            q.cond.notify_all()
+
+    async def _execute(self, spec: TaskSpec, is_actor_task: bool):
+        loop = asyncio.get_running_loop()
+        start = time.time()
+        self.current_task_id = spec.task_id
+        self.current_job_id = spec.job_id
+        # Nested submissions from this task belong to the task's job.
+        self.cw.job_id = spec.job_id
+        try:
+            args, kwargs = await self._resolve_args(spec)
+        except serialization.TaskError as e:
+            # A dependency failed: propagate its error as ours.
+            sv = serialization.serialize_error(e.cause, spec.name)
+            return self._error_result(sv, app_error=True)
+        except Exception as e:
+            sv = serialization.serialize_error(e, spec.name)
+            return self._error_result(sv, app_error=False)
+        try:
+            if is_actor_task:
+                method = getattr(self.actor_instance, spec.method_name)
+                if inspect.iscoroutinefunction(method):
+                    self._ensure_user_loop()
+                    cfut = asyncio.run_coroutine_threadsafe(
+                        self._run_async_method(method, args, kwargs), self._user_loop
+                    )
+                    value = await asyncio.wrap_future(cfut)
+                else:
+                    value = await loop.run_in_executor(
+                        self.pool, lambda: method(*args, **kwargs)
+                    )
+            else:
+                func = cloudpickle.loads(spec.func_blob)
+                if inspect.iscoroutinefunction(func):
+                    self._ensure_user_loop()
+                    cfut = asyncio.run_coroutine_threadsafe(
+                        func(*args, **kwargs), self._user_loop
+                    )
+                    value = await asyncio.wrap_future(cfut)
+                else:
+                    value = await loop.run_in_executor(
+                        self.pool, lambda: func(*args, **kwargs)
+                    )
+        except Exception as e:
+            sv = serialization.serialize_error(e, spec.name)
+            return self._error_result(sv, app_error=True)
+        finally:
+            self.current_task_id = None
+        return self._package_returns(spec, value, start)
+
+    async def _run_async_method(self, method, args, kwargs):
+        if self._async_sem is None or self._async_sem._value > self.max_concurrency:
+            self._async_sem = asyncio.Semaphore(self.max_concurrency)
+        async with self._async_sem:
+            return await method(*args, **kwargs)
+
+    # ------------------------------------------------------------------
+    async def _resolve_args(self, spec: TaskSpec):
+        args = [await self._resolve_one(a) for a in spec.args]
+        kwargs = {k: await self._resolve_one(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    async def _resolve_one(self, slot):
+        kind = slot[0]
+        if kind == "v":
+            return serialization.deserialize(slot[1], slot[2])
+        oid_bytes = slot[1]
+        oid = ObjectID(oid_bytes)
+        buf = object_store.read_object(self.cw.store_dir, oid)
+        if buf is None:
+            ok = await self.cw.raylet.request("pull_object", {"object_id": oid_bytes})
+            if not ok.get("ok"):
+                raise RuntimeError(f"task argument {oid_bytes.hex()[:16]} unavailable")
+            buf = object_store.read_object(self.cw.store_dir, oid)
+            if buf is None:
+                raise RuntimeError(f"task argument {oid_bytes.hex()[:16]} unavailable")
+        # Do not release the buffer: returned values may alias the mmap; the
+        # mapping stays alive as long as any view does (plasma zero-copy).
+        return serialization.deserialize(buf.metadata, buf.data)
+
+    # ------------------------------------------------------------------
+    def _package_returns(self, spec: TaskSpec, value: Any, start: float):
+        values = (value,) if spec.num_returns == 1 else tuple(value)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            sv = serialization.serialize_error(
+                ValueError(
+                    f"task returned {len(values)} values, expected {spec.num_returns}"
+                ),
+                spec.name,
+            )
+            return self._error_result(sv, app_error=True)
+        results = []
+        stored = []
+        tid = TaskID(spec.task_id)
+        for i, v in enumerate(values):
+            try:
+                sv = serialization.serialize(v)
+            except Exception as e:
+                esv = serialization.serialize_error(e, spec.name)
+                return self._error_result(esv, app_error=True)
+            if sv.nested_refs:
+                # Refs escaping via a return value: owner must keep them alive.
+                self.cw.pin_escaped(sv.nested_refs)
+            if sv.total_data_len <= cfg.max_direct_call_object_size:
+                results.append(("v", sv.metadata, sv.to_bytes()))
+            else:
+                oid = ObjectID.from_index(tid, i + 1)
+                object_store.write_object(
+                    self.cw.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
+                )
+                stored.append(oid.binary())
+                results.append(("r", oid.binary()))
+        return {
+            "results": results,
+            "stored_objects": stored,
+            "duration": time.time() - start,
+        }
+
+    def _error_result(self, sv: serialization.SerializedValue, app_error: bool):
+        return {
+            "results": None,
+            "error": "task raised" if app_error else "task system error",
+            "error_value": (sv.metadata, sv.to_bytes()),
+            "app_error": app_error,
+            "retriable": True,
+        }

@@ -1,0 +1,622 @@
+"""Global Control Service: head-node metadata server + cluster-level scheduling.
+
+Analog of the reference's GcsServer (ray: src/ray/gcs/gcs_server/gcs_server.h:79)
+composing sub-managers: node membership + health (gcs_node_manager.h,
+gcs_health_check_manager.h), cluster resource view (gcs_resource_manager.h),
+actor lifetime + fault tolerance (gcs_actor_manager.h, gcs_actor_scheduler.h),
+placement groups (gcs_placement_group_manager.h, 2-phase prepare/commit),
+jobs (gcs_job_manager.h), internal KV (gcs_kv_manager.h), pubsub
+(pubsub_handler.h), and the object directory (here centralized; the reference
+uses owner-based lookup). State lives in a pluggable store (in-memory dict
+now; the interface allows a persistent backend for GCS fault tolerance).
+
+Raylets and drivers hold persistent duplex connections; the GCS pushes
+cluster-view updates and actor/node pubsub over them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+from ray_tpu._private.common import NodeInfo, TaskSpec, place_bundles, res_fits
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.rpcio import Connection, RpcServer
+
+logger = logging.getLogger(__name__)
+
+# Actor states (ray: gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorRecord:
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.actor_id: bytes = spec.actor_id
+        self.state = PENDING_CREATION
+        self.node_id: Optional[str] = None
+        self.address: Optional[tuple] = None  # (node_id_hex, worker_client_id)
+        self.num_restarts = 0
+        self.name = spec.name_registered
+        self.namespace = spec.namespace or "default"
+        self.death_cause: Optional[str] = None
+        self.owner_conn_key: Optional[str] = None  # owning driver/worker client id
+
+    def to_table(self):
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "node_id": self.node_id,
+            "address": self.address,
+            "name": self.name,
+            "namespace": self.namespace,
+            "num_restarts": self.num_restarts,
+            "class_name": self.spec.name,
+            "death_cause": self.death_cause,
+            "pid": None,
+        }
+
+
+class PlacementGroupRecord:
+    def __init__(self, pg_id: str, bundles, strategy: str, name: str, job_id: bytes,
+                 lifetime: Optional[str]):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.job_id = job_id
+        self.lifetime = lifetime
+        self.state = "PENDING"
+        self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+
+    def to_table(self):
+        return {
+            "placement_group_id": self.pg_id,
+            "name": self.name,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundle_nodes": self.bundle_nodes,
+        }
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(self, host, port)
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.node_conns: Dict[str, Connection] = {}
+        self.client_conns: Dict[str, Connection] = {}  # drivers/workers subscribed
+        self.actors: Dict[bytes, ActorRecord] = {}
+        self.named_actors: Dict[tuple, bytes] = {}  # (namespace, name) -> actor_id
+        self.jobs: Dict[bytes, dict] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.pgs: Dict[str, PlacementGroupRecord] = {}
+        self.object_dir: Dict[bytes, Set[str]] = {}
+        self.object_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self.subscribers: Dict[str, Set[Connection]] = {}  # channel -> conns
+        self._pg_lock = asyncio.Lock()
+        self._next_job = 1
+        self._started = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self.task_events: List[dict] = []  # bounded task-event log for state API
+
+    async def start(self):
+        port = await self.server.start()
+        self._tasks.append(asyncio.get_running_loop().create_task(self._health_loop()))
+        self._started.set()
+        logger.info("GCS listening on %s", port)
+        return port
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------------
+    # Connection lifecycle
+    # ------------------------------------------------------------------
+    def on_disconnect(self, conn: Connection):
+        kind = conn.meta.get("kind")
+        if kind == "raylet":
+            node_id = conn.meta["node_id"]
+            self.node_conns.pop(node_id, None)
+            return self._mark_node_dead(node_id, "raylet disconnected")
+        if kind == "client":
+            self.client_conns.pop(conn.meta.get("client_id"), None)
+            job_id = conn.meta.get("job_id")
+            if conn.meta.get("is_driver") and job_id is not None:
+                return self._on_driver_exit(job_id)
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+
+    async def _on_driver_exit(self, job_id: bytes):
+        """Driver died/finished: finish job, destroy its non-detached actors."""
+        job = self.jobs.get(job_id)
+        if job:
+            job["is_dead"] = True
+            job["end_time"] = time.time()
+        for rec in list(self.actors.values()):
+            if rec.spec.job_id == job_id and rec.spec.lifetime != "detached" \
+                    and rec.state != DEAD:
+                await self._destroy_actor(rec, "owner job finished")
+        for pg in list(self.pgs.values()):
+            if pg.job_id == job_id and pg.lifetime != "detached":
+                await self._remove_pg(pg.pg_id)
+
+    # ------------------------------------------------------------------
+    # Node manager (+ health checks)
+    # ------------------------------------------------------------------
+    async def rpc_register_node(self, conn: Connection, info: dict):
+        node = NodeInfo(**info)
+        node.resources_available = dict(node.resources_total)
+        self.nodes[node.node_id] = node
+        conn.meta.update(kind="raylet", node_id=node.node_id)
+        self.node_conns[node.node_id] = conn
+        await self._publish("node", {"event": "alive", "node": info})
+        await self._broadcast_view()
+        return {"node_id": node.node_id, "nodes": self._view()}
+
+    async def rpc_heartbeat(self, conn: Connection, payload: dict):
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {"reregister": True}
+        node.last_heartbeat = time.monotonic()
+        node.resources_available = payload["resources_available"]
+        if not node.alive:
+            node.alive = True
+        return {}
+
+    async def rpc_get_nodes(self, conn: Connection, _):
+        return self._view()
+
+    def _view(self):
+        return [
+            {
+                "node_id": n.node_id,
+                "host": n.host,
+                "port": n.port,
+                "store_dir": n.store_dir,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "labels": n.labels,
+                "alive": n.alive,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def _broadcast_view(self):
+        view = self._view()
+        for nid, conn in list(self.node_conns.items()):
+            try:
+                await conn.notify("cluster_view", view)
+            except Exception:
+                pass
+
+    async def _health_loop(self):
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
+                    await self._mark_node_dead(node.node_id, "heartbeat timeout")
+            await self._broadcast_view()
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        await self._publish("node", {"event": "dead", "node_id": node_id, "reason": reason})
+        # Restart or fail actors that lived there.
+        for rec in list(self.actors.values()):
+            if rec.node_id == node_id and rec.state in (ALIVE, PENDING_CREATION):
+                await self._handle_actor_failure(rec, f"node died: {reason}")
+        await self._broadcast_view()
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+    async def rpc_register_job(self, conn: Connection, payload: dict):
+        job_num = self._next_job
+        self._next_job += 1
+        from ray_tpu._private.ids import JobID
+
+        job_id = JobID.from_int(job_num).binary()
+        self.jobs[job_id] = {
+            "job_id": job_id,
+            "start_time": time.time(),
+            "is_dead": False,
+            "driver": payload.get("driver", {}),
+            "namespace": payload.get("namespace") or "default",
+            "end_time": None,
+        }
+        return {"job_id": job_id}
+
+    async def rpc_register_client(self, conn: Connection, payload: dict):
+        conn.meta.update(
+            kind="client",
+            client_id=payload["client_id"],
+            job_id=payload.get("job_id"),
+            is_driver=payload.get("is_driver", False),
+        )
+        self.client_conns[payload["client_id"]] = conn
+        return {}
+
+    async def rpc_list_jobs(self, conn: Connection, _):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # Internal KV (ray: gcs_kv_manager.h)
+    # ------------------------------------------------------------------
+    async def rpc_kv_put(self, conn: Connection, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        existed = p["key"] in ns
+        if p.get("overwrite", True) or not existed:
+            ns[p["key"]] = p["value"]
+        return {"added": not existed}
+
+    async def rpc_kv_get(self, conn: Connection, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    async def rpc_kv_del(self, conn: Connection, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        if p.get("prefix"):
+            keys = [k for k in ns if k.startswith(p["key"])]
+            for k in keys:
+                del ns[k]
+            return len(keys)
+        return 1 if ns.pop(p["key"], None) is not None else 0
+
+    async def rpc_kv_keys(self, conn: Connection, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        return [k for k in ns if k.startswith(p.get("prefix", b""))]
+
+    async def rpc_kv_exists(self, conn: Connection, p):
+        return p["key"] in self.kv.get(p.get("ns", ""), {})
+
+    # ------------------------------------------------------------------
+    # Pubsub (ray: src/ray/pubsub/)
+    # ------------------------------------------------------------------
+    async def rpc_subscribe(self, conn: Connection, p):
+        self.subscribers.setdefault(p["channel"], set()).add(conn)
+        return {}
+
+    async def rpc_publish(self, conn: Connection, p):
+        await self._publish(p["channel"], p["message"])
+        return {}
+
+    async def _publish(self, channel: str, message):
+        for conn in list(self.subscribers.get(channel, ())):
+            if conn.closed:
+                self.subscribers[channel].discard(conn)
+                continue
+            try:
+                await conn.notify("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # Object directory (centralized variant of the ownership directory)
+    # ------------------------------------------------------------------
+    async def rpc_add_object_location(self, conn: Connection, p):
+        oid, node_id = p["object_id"], p["node_id"]
+        self.object_dir.setdefault(oid, set()).add(node_id)
+        waiters = self.object_waiters.pop(oid, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result([node_id])
+        return {}
+
+    async def rpc_remove_object_location(self, conn: Connection, p):
+        locs = self.object_dir.get(p["object_id"])
+        if locs:
+            locs.discard(p["node_id"])
+            if not locs:
+                del self.object_dir[p["object_id"]]
+        return {}
+
+    async def rpc_get_object_locations(self, conn: Connection, p):
+        locs = self.object_dir.get(p["object_id"], set())
+        live = [nid for nid in locs if self.nodes.get(nid) and self.nodes[nid].alive]
+        if live or not p.get("wait"):
+            return live
+        fut = asyncio.get_running_loop().create_future()
+        self.object_waiters.setdefault(p["object_id"], []).append(fut)
+        try:
+            return await asyncio.wait_for(fut, p.get("timeout", cfg.object_pull_timeout_s))
+        except asyncio.TimeoutError:
+            return []
+
+    async def rpc_free_object(self, conn: Connection, p):
+        """Owner released the object: tell all holding raylets to delete it."""
+        oid = p["object_id"]
+        locs = self.object_dir.pop(oid, set())
+        for nid in locs:
+            nconn = self.node_conns.get(nid)
+            if nconn:
+                try:
+                    await nconn.notify("delete_object", {"object_id": oid})
+                except Exception:
+                    pass
+        return {}
+
+    # ------------------------------------------------------------------
+    # Actor manager + scheduler (ray: gcs_actor_manager.h, gcs_actor_scheduler.h)
+    # ------------------------------------------------------------------
+    async def rpc_register_actor(self, conn: Connection, p):
+        spec: TaskSpec = p["spec"]
+        rec = ActorRecord(spec)
+        rec.owner_conn_key = conn.meta.get("client_id")
+        if rec.name:
+            key = (rec.namespace, rec.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != DEAD:
+                    return {"error": f"actor name '{rec.name}' already taken"}
+            self.named_actors[key] = rec.actor_id
+        self.actors[rec.actor_id] = rec
+        asyncio.get_running_loop().create_task(self._schedule_actor(rec))
+        return {"actor_id": rec.actor_id}
+
+    async def _schedule_actor(self, rec: ActorRecord):
+        # Per-actor scheduling loop; no global lock — concurrent creations
+        # race on node resources and rely on raylet-side admission (rejects)
+        # plus retry, like the reference's per-actor GcsActorScheduler.
+        if rec.state == DEAD:
+            return
+        rec.state = PENDING_CREATION
+        await self._publish_actor(rec)
+        spec = rec.spec
+        from ray_tpu._private.common import SchedulingStrategy, pick_node
+
+        demand = dict(spec.resources)
+        strategy = spec.scheduling or SchedulingStrategy()
+        deadline = time.monotonic() + cfg.worker_lease_timeout_ms / 1000.0
+        rr = [0]
+        while time.monotonic() < deadline:
+            if rec.state == DEAD:
+                return
+            nodes = [n for n in self.nodes.values() if n.alive]
+            target = pick_node(nodes, demand, strategy, None, rr,
+                               cfg.scheduler_spread_threshold)
+            if target is None or self.node_conns.get(target) is None:
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                reply = await self.node_conns[target].request(
+                    "create_actor", {"spec": spec}, timeout=cfg.gcs_rpc_timeout_s
+                )
+            except Exception as e:
+                logger.warning("actor creation on %s failed: %s", target[:8], e)
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("rejected"):
+                await asyncio.sleep(0.1)
+                continue
+            if reply.get("error"):
+                rec.state = DEAD
+                rec.death_cause = reply["error"]
+                await self._publish_actor(rec)
+                return
+            rec.node_id = target
+            rec.address = (target, reply["worker_client_id"])
+            rec.state = ALIVE
+            await self._publish_actor(rec)
+            return
+        rec.state = DEAD
+        rec.death_cause = "actor creation timed out (no feasible node)"
+        await self._publish_actor(rec)
+
+    async def _publish_actor(self, rec: ActorRecord):
+        await self._publish("actor", rec.to_table())
+
+    async def rpc_get_actor(self, conn: Connection, p):
+        rec = None
+        if p.get("actor_id"):
+            rec = self.actors.get(p["actor_id"])
+        elif p.get("name"):
+            aid = self.named_actors.get((p.get("namespace") or "default", p["name"]))
+            rec = self.actors.get(aid) if aid else None
+            if rec and rec.state == DEAD:
+                rec = None
+        return rec.to_table() if rec else None
+
+    async def rpc_list_actors(self, conn: Connection, _):
+        return [r.to_table() for r in self.actors.values()]
+
+    async def rpc_wait_actor_alive(self, conn: Connection, p):
+        """Block until the actor is ALIVE or DEAD; returns its table entry."""
+        deadline = time.monotonic() + p.get("timeout", cfg.gcs_rpc_timeout_s)
+        while time.monotonic() < deadline:
+            rec = self.actors.get(p["actor_id"])
+            if rec is None:
+                return None
+            if rec.state in (ALIVE, DEAD):
+                return rec.to_table()
+            await asyncio.sleep(0.02)
+        rec = self.actors.get(p["actor_id"])
+        return rec.to_table() if rec else None
+
+    async def rpc_actor_died(self, conn: Connection, p):
+        """Raylet reports an actor worker exited."""
+        rec = self.actors.get(p["actor_id"])
+        if rec is None or rec.state == DEAD:
+            return {}
+        if p.get("intended"):
+            await self._destroy_actor(rec, p.get("reason", "killed"))
+        else:
+            await self._handle_actor_failure(rec, p.get("reason", "worker died"))
+        return {}
+
+    async def _handle_actor_failure(self, rec: ActorRecord, reason: str):
+        max_restarts = rec.spec.max_restarts
+        if max_restarts == -1 or rec.num_restarts < max_restarts:
+            rec.num_restarts += 1
+            rec.state = RESTARTING
+            rec.node_id = None
+            rec.address = None
+            await self._publish_actor(rec)
+            await asyncio.sleep(cfg.actor_restart_delay_ms / 1000.0)
+            asyncio.get_running_loop().create_task(self._schedule_actor(rec))
+        else:
+            await self._destroy_actor(rec, reason)
+
+    async def _destroy_actor(self, rec: ActorRecord, reason: str):
+        rec.state = DEAD
+        rec.death_cause = reason
+        if rec.name:
+            self.named_actors.pop((rec.namespace, rec.name), None)
+        if rec.node_id and rec.address:
+            nconn = self.node_conns.get(rec.node_id)
+            if nconn:
+                try:
+                    await nconn.notify(
+                        "kill_actor", {"actor_id": rec.actor_id, "no_restart": True}
+                    )
+                except Exception:
+                    pass
+        await self._publish_actor(rec)
+
+    async def rpc_kill_actor(self, conn: Connection, p):
+        rec = self.actors.get(p["actor_id"])
+        if rec is None:
+            return {}
+        if p.get("no_restart", True):
+            await self._destroy_actor(rec, "ray.kill")
+        else:
+            await self._handle_actor_failure(rec, "ray.kill(no_restart=False)")
+        return {}
+
+    # ------------------------------------------------------------------
+    # Placement groups (ray: gcs_placement_group_manager.h — 2-phase commit)
+    # ------------------------------------------------------------------
+    async def rpc_create_placement_group(self, conn: Connection, p):
+        pg = PlacementGroupRecord(
+            p["pg_id"], p["bundles"], p["strategy"], p.get("name", ""),
+            p.get("job_id"), p.get("lifetime"),
+        )
+        self.pgs[pg.pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"pg_id": pg.pg_id}
+
+    async def _schedule_pg(self, pg: PlacementGroupRecord):
+        deadline = time.monotonic() + cfg.worker_lease_timeout_ms / 1000.0
+        while pg.state == "PENDING" and time.monotonic() < deadline:
+            placed = await self._try_place_pg(pg)
+            if placed:
+                return
+            await asyncio.sleep(0.2)
+        if pg.state == "PENDING":
+            pg.state = "INFEASIBLE"
+            await self._publish("pg", pg.to_table())
+
+    async def _try_place_pg(self, pg: PlacementGroupRecord) -> bool:
+        # The lock covers one atomic place+prepare+commit attempt so two PGs
+        # don't interleave reservations; waiting happens outside it.
+        async with self._pg_lock:
+                nodes = [n for n in self.nodes.values() if n.alive]
+                placement = place_bundles(nodes, pg.bundles, pg.strategy)
+                if placement is None:
+                    return False
+                # Phase 1: prepare (reserve) on each node.
+                prepared = []
+                ok = True
+                for idx, node_id in enumerate(placement):
+                    nconn = self.node_conns.get(node_id)
+                    if nconn is None:
+                        ok = False
+                        break
+                    try:
+                        r = await nconn.request(
+                            "pg_prepare",
+                            {"pg_id": pg.pg_id, "bundle_index": idx,
+                             "resources": pg.bundles[idx]},
+                            timeout=cfg.gcs_rpc_timeout_s,
+                        )
+                    except Exception:
+                        ok = False
+                        break
+                    if not r.get("ok"):
+                        ok = False
+                        break
+                    prepared.append((idx, node_id))
+                if not ok:
+                    for idx, node_id in prepared:
+                        nconn = self.node_conns.get(node_id)
+                        if nconn:
+                            try:
+                                await nconn.notify(
+                                    "pg_cancel", {"pg_id": pg.pg_id, "bundle_index": idx}
+                                )
+                            except Exception:
+                                pass
+                    return False
+                # Phase 2: commit.
+                for idx, node_id in prepared:
+                    nconn = self.node_conns.get(node_id)
+                    await nconn.request(
+                        "pg_commit", {"pg_id": pg.pg_id, "bundle_index": idx},
+                        timeout=cfg.gcs_rpc_timeout_s,
+                    )
+                pg.bundle_nodes = list(placement)
+                pg.state = "CREATED"
+                await self._publish("pg", pg.to_table())
+                return True
+
+    async def rpc_wait_placement_group(self, conn: Connection, p):
+        deadline = time.monotonic() + p.get("timeout", cfg.gcs_rpc_timeout_s)
+        while time.monotonic() < deadline:
+            pg = self.pgs.get(p["pg_id"])
+            if pg is None:
+                return None
+            if pg.state in ("CREATED", "INFEASIBLE", "REMOVED"):
+                return pg.to_table()
+            await asyncio.sleep(0.02)
+        pg = self.pgs.get(p["pg_id"])
+        return pg.to_table() if pg else None
+
+    async def rpc_remove_placement_group(self, conn: Connection, p):
+        await self._remove_pg(p["pg_id"])
+        return {}
+
+    async def _remove_pg(self, pg_id: str):
+        pg = self.pgs.get(pg_id)
+        if pg is None or pg.state == "REMOVED":
+            return
+        for idx, node_id in enumerate(pg.bundle_nodes):
+            if node_id is None:
+                continue
+            nconn = self.node_conns.get(node_id)
+            if nconn:
+                try:
+                    await nconn.notify("pg_return", {"pg_id": pg_id, "bundle_index": idx})
+                except Exception:
+                    pass
+        pg.state = "REMOVED"
+        await self._publish("pg", pg.to_table())
+
+    async def rpc_pg_table(self, conn: Connection, p):
+        if p and p.get("pg_id"):
+            pg = self.pgs.get(p["pg_id"])
+            return pg.to_table() if pg else None
+        return [pg.to_table() for pg in self.pgs.values()]
+
+    # ------------------------------------------------------------------
+    # Task events (observability; ray: gcs_task_manager.h)
+    # ------------------------------------------------------------------
+    async def rpc_add_task_events(self, conn: Connection, p):
+        self.task_events.extend(p["events"])
+        overflow = len(self.task_events) - cfg.task_events_buffer_size
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return {}
+
+    async def rpc_list_task_events(self, conn: Connection, p):
+        return self.task_events[-(p.get("limit") or 1000):]

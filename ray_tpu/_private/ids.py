@@ -1,0 +1,169 @@
+"""Binary ID types with embedded lineage.
+
+Mirrors the reference's ID hierarchy (ray: src/ray/common/id.h): JobID (4B) is
+embedded in ActorID (16B), ActorID in TaskID (24B), and TaskID in ObjectID
+(28B, TaskID + 4B little-endian return index). IDs are immutable bytes with
+hex round-tripping; random IDs come from ``os.urandom``.
+"""
+
+from __future__ import annotations
+
+import os
+
+JOB_ID_SIZE = 4
+ACTOR_ID_UNIQUE_BYTES = 12
+ACTOR_ID_SIZE = ACTOR_ID_UNIQUE_BYTES + JOB_ID_SIZE  # 16
+TASK_ID_UNIQUE_BYTES = 8
+TASK_ID_SIZE = TASK_ID_UNIQUE_BYTES + ACTOR_ID_SIZE  # 24
+OBJECT_ID_INDEX_BYTES = 4
+OBJECT_ID_SIZE = TASK_ID_SIZE + OBJECT_ID_INDEX_BYTES  # 28
+UNIQUE_ID_SIZE = 28
+NODE_ID_SIZE = 28
+WORKER_ID_SIZE = 28
+PLACEMENT_GROUP_ID_SIZE = 18
+
+
+class BaseID:
+    """Immutable fixed-width binary identifier."""
+
+    SIZE = UNIQUE_ID_SIZE
+    __slots__ = ("_binary", "_hash")
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, (bytes, bytearray)):
+            raise TypeError(f"{type(self).__name__} requires bytes, got {type(binary)}")
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        object.__setattr__(self, "_binary", bytes(binary))
+        object.__setattr__(self, "_hash", hash((type(self).__name__, bytes(binary))))
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def is_nil(self) -> bool:
+        return self._binary == b"\xff" * self.SIZE
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __lt__(self, other):
+        return self._binary < other._binary
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class UniqueID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class NodeID(BaseID):
+    SIZE = NODE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = WORKER_ID_SIZE
+
+
+class JobID(BaseID):
+    SIZE = JOB_ID_SIZE
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(JOB_ID_SIZE, "little"))
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._binary, "little")
+
+
+class ActorID(BaseID):
+    SIZE = ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(ACTOR_ID_UNIQUE_BYTES) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[ACTOR_ID_UNIQUE_BYTES:])
+
+
+class TaskID(BaseID):
+    SIZE = TASK_ID_SIZE
+
+    @classmethod
+    def for_task(cls, job_id: JobID) -> "TaskID":
+        nil_actor = b"\xff" * ACTOR_ID_UNIQUE_BYTES + job_id.binary()
+        return cls(os.urandom(TASK_ID_UNIQUE_BYTES) + nil_actor)
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(os.urandom(TASK_ID_UNIQUE_BYTES) + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        nil_actor = b"\xff" * ACTOR_ID_UNIQUE_BYTES + job_id.binary()
+        return cls(b"\x00" * TASK_ID_UNIQUE_BYTES + nil_actor)
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._binary[TASK_ID_UNIQUE_BYTES:])
+
+    def job_id(self) -> JobID:
+        return self.actor_id().job_id()
+
+
+class ObjectID(BaseID):
+    SIZE = OBJECT_ID_SIZE
+
+    @classmethod
+    def from_index(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """Return object for the index-th return of task (1-based, like the ref)."""
+        return cls(task_id.binary() + index.to_bytes(OBJECT_ID_INDEX_BYTES, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Put objects use the high bit of the index to avoid colliding with returns.
+        idx = put_index | 0x80000000
+        return cls(task_id.binary() + idx.to_bytes(OBJECT_ID_INDEX_BYTES, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._binary[:TASK_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._binary[TASK_ID_SIZE:], "little") & 0x7FFFFFFF
+
+
+class PlacementGroupID(BaseID):
+    SIZE = PLACEMENT_GROUP_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "PlacementGroupID":
+        return cls(os.urandom(PLACEMENT_GROUP_ID_SIZE - JOB_ID_SIZE) + job_id.binary())

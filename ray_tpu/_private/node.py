@@ -1,0 +1,141 @@
+"""Node process orchestration.
+
+Analog of ray: python/ray/_private/node.py:37 Node + services.py: starts and
+owns the per-node processes (GCS on the head, a raylet per node), discovers
+their ports via port files, and tears them down on shutdown. Sessions live
+under /dev/shm when available so the object store's files are true shared
+memory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional
+
+DEFAULT_SESSION_ROOT = "/dev/shm/ray_tpu" if os.path.isdir("/dev/shm") else None
+
+
+def _make_session_dir(session_root: Optional[str] = None) -> str:
+    root = session_root or DEFAULT_SESSION_ROOT or os.path.join(
+        tempfile.gettempdir(), "ray_tpu"
+    )
+    session_dir = os.path.join(root, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    return session_dir
+
+
+def _wait_port_file(path: str, timeout: float = 30.0) -> list:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip().split("\n")
+        time.sleep(0.05)
+    raise TimeoutError(f"process did not write port file {path}")
+
+
+def package_env(env: Optional[dict] = None) -> dict:
+    """Env with PYTHONPATH including ray_tpu's parent dir, so subprocesses can
+    import the package regardless of the caller's cwd/installation."""
+    env = dict(env if env is not None else os.environ)
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+    return env
+
+
+def _spawn(cmd, log_path: str, env=None) -> subprocess.Popen:
+    out = open(log_path, "ab")
+    return subprocess.Popen(
+        cmd, stdout=out, stderr=subprocess.STDOUT, env=package_env(env)
+    )
+
+
+class NodeProcesses:
+    """Starts GCS (head only) + raylet subprocesses for one logical node."""
+
+    def __init__(
+        self,
+        head: bool = True,
+        gcs_host: str = "127.0.0.1",
+        gcs_port: Optional[int] = None,
+        session_dir: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.head = head
+        self.session_dir = session_dir or _make_session_dir()
+        self.logs = os.path.join(self.session_dir, "logs")
+        os.makedirs(self.logs, exist_ok=True)
+        self.gcs_host = gcs_host
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        suffix = uuid.uuid4().hex[:8]
+        if head:
+            port_file = os.path.join(self.session_dir, f"gcs_port_{suffix}")
+            self.gcs_proc = _spawn(
+                [sys.executable, "-m", "ray_tpu._private.gcs_main",
+                 "--host", gcs_host, "--port", "0", "--port-file", port_file],
+                os.path.join(self.logs, "gcs.out"),
+                env=dict(os.environ),
+            )
+            self.gcs_port = int(_wait_port_file(port_file)[0])
+        else:
+            assert gcs_port is not None
+            self.gcs_port = gcs_port
+        raylet_port_file = os.path.join(self.session_dir, f"raylet_port_{suffix}")
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.raylet_main",
+            "--gcs-host", gcs_host, "--gcs-port", str(self.gcs_port),
+            "--session-dir", self.session_dir,
+            "--port-file", raylet_port_file,
+        ]
+        if resources is not None:
+            cmd += ["--resources", json.dumps(resources)]
+        if labels is not None:
+            cmd += ["--labels", json.dumps(labels)]
+        self.raylet_proc = _spawn(
+            cmd, os.path.join(self.logs, f"raylet_{suffix}.out"), env=dict(os.environ)
+        )
+        lines = _wait_port_file(raylet_port_file)
+        self.raylet_port = int(lines[0])
+        self.node_id = lines[1] if len(lines) > 1 else None
+
+    @property
+    def address(self) -> str:
+        return f"{self.gcs_host}:{self.gcs_port}"
+
+    def kill_raylet(self, graceful: bool = False):
+        """Chaos hook (analog of ray: _private/test_utils.py NodeKillerActor)."""
+        if graceful:
+            self.raylet_proc.terminate()
+        else:
+            self.raylet_proc.kill()
+        self.raylet_proc.wait(timeout=10)
+
+    def shutdown(self):
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is None:
+                continue
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass

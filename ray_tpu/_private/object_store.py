@@ -1,0 +1,222 @@
+"""Shared-memory local object store (plasma analog).
+
+The reference's plasma store (ray: src/ray/object_manager/plasma/store.h) is a
+shm arena with create/seal/get/release and LRU eviction; workers map segments
+read-only for zero-copy reads. Here each sealed object is a file in a
+``/dev/shm``-backed session directory mapped with ``mmap``:
+
+  layout:  [8B magic][8B metadata_len][8B data_len][metadata][data]
+
+Writers create ``<id>.building`` then atomically rename to ``<id>.obj`` on
+seal, so any process on the node can open + mmap a sealed object without
+talking to a broker: the data plane is the kernel page cache, exactly one
+copy per node. Accounting (capacity, pinning, LRU eviction) is done by the
+raylet process that owns the store directory; readers in other processes only
+open/mmap.
+
+A C++ implementation with the same on-disk format can replace the
+writer/accounting path without changing readers.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+_MAGIC = b"RTPUOBJ1"
+_HEADER = 24
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+@dataclass
+class ObjectBuffer:
+    """A sealed object mapped into this process (zero-copy views)."""
+
+    object_id: ObjectID
+    metadata: bytes
+    data: memoryview
+    _mmap: mmap.mmap = None
+    _file: object = None
+
+    def release(self):
+        if self._mmap is not None:
+            try:
+                self.data.release()
+            except BufferError:
+                pass
+            self._mmap.close()
+            self._file.close()
+            self._mmap = None
+
+
+def _obj_path(store_dir: str, object_id: ObjectID) -> str:
+    return os.path.join(store_dir, object_id.hex() + ".obj")
+
+
+def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
+    """Open and mmap a sealed object. Returns None if absent. Any process."""
+    path = _obj_path(store_dir, object_id)
+    try:
+        f = open(path, "rb")
+    except FileNotFoundError:
+        return None
+    m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    if m[:8] != _MAGIC:
+        m.close()
+        f.close()
+        raise IOError(f"corrupt object {object_id}")
+    meta_len = int.from_bytes(m[8:16], "little")
+    data_len = int.from_bytes(m[16:24], "little")
+    metadata = bytes(m[_HEADER : _HEADER + meta_len])
+    data = memoryview(m)[_HEADER + meta_len : _HEADER + meta_len + data_len]
+    return ObjectBuffer(object_id, metadata, data, _mmap=m, _file=f)
+
+
+def object_exists(store_dir: str, object_id: ObjectID) -> bool:
+    return os.path.exists(_obj_path(store_dir, object_id))
+
+
+def write_object(
+    store_dir: str,
+    object_id: ObjectID,
+    metadata: bytes,
+    buffers: Iterable,
+    total_data_len: int,
+) -> int:
+    """Create + seal an object from buffers. Returns bytes written.
+
+    Safe from any process; accounting is reconciled by the owning store's
+    directory scan. Writing an already-sealed id is a no-op (objects are
+    immutable, so double-writes are benign).
+    """
+    final = _obj_path(store_dir, object_id)
+    if os.path.exists(final):
+        return 0
+    tmp = final + f".building.{os.getpid()}"
+    size = _HEADER + len(metadata) + total_data_len
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(len(metadata).to_bytes(8, "little"))
+        f.write(total_data_len.to_bytes(8, "little"))
+        f.write(metadata)
+        for buf in buffers:
+            f.write(buf)
+    os.rename(tmp, final)
+    return size
+
+
+class LocalObjectStore:
+    """Owner-side store accounting: capacity, pinning, LRU eviction.
+
+    Runs inside the raylet (one per node). Mirrors the reference's
+    ObjectLifecycleManager + EvictionPolicy
+    (ray: src/ray/object_manager/plasma/object_lifecycle_manager.h:101,
+    eviction_policy.h:160).
+    """
+
+    def __init__(self, store_dir: str, capacity_bytes: int):
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._sizes: Dict[ObjectID, int] = {}
+        self._lru: "OrderedDict[ObjectID, float]" = OrderedDict()
+        self._pinned: Dict[ObjectID, int] = {}
+        self._used = 0
+
+    # -- write path ----------------------------------------------------------
+    def put(self, object_id: ObjectID, metadata: bytes, buffers, total_data_len: int):
+        size = _HEADER + len(metadata) + total_data_len
+        self._ensure_space(size)
+        written = write_object(self.store_dir, object_id, metadata, buffers, total_data_len)
+        if written:
+            with self._lock:
+                self._sizes[object_id] = written
+                self._used += written
+                self._lru[object_id] = time.monotonic()
+
+    def register_external(self, object_id: ObjectID):
+        """Account for an object written directly by a worker process."""
+        path = _obj_path(self.store_dir, object_id)
+        try:
+            size = os.path.getsize(path)
+        except FileNotFoundError:
+            return
+        with self._lock:
+            if object_id not in self._sizes:
+                self._sizes[object_id] = size
+                self._used += size
+                self._lru[object_id] = time.monotonic()
+
+    # -- read path -----------------------------------------------------------
+    def get(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
+        buf = read_object(self.store_dir, object_id)
+        if buf is not None:
+            with self._lock:
+                if object_id in self._lru:
+                    self._lru.move_to_end(object_id)
+        return buf
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_exists(self.store_dir, object_id)
+
+    # -- lifecycle -----------------------------------------------------------
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            n = self._pinned.get(object_id, 0) - 1
+            if n <= 0:
+                self._pinned.pop(object_id, None)
+            else:
+                self._pinned[object_id] = n
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._delete_locked(object_id)
+
+    def _delete_locked(self, object_id: ObjectID):
+        try:
+            os.unlink(_obj_path(self.store_dir, object_id))
+        except FileNotFoundError:
+            pass
+        size = self._sizes.pop(object_id, 0)
+        self._used -= size
+        self._lru.pop(object_id, None)
+        self._pinned.pop(object_id, None)
+
+    def _ensure_space(self, size: int):
+        with self._lock:
+            if self._used + size <= self.capacity:
+                return
+            # LRU-evict unpinned objects until there is room.
+            for oid in list(self._lru.keys()):
+                if self._used + size <= self.capacity:
+                    break
+                if oid in self._pinned:
+                    continue
+                self._delete_locked(oid)
+            if self._used + size > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object of size {size} does not fit: used={self._used} "
+                    f"capacity={self.capacity} (all remaining objects pinned)"
+                )
+
+    def used_bytes(self) -> int:
+        return self._used
+
+    def object_ids(self):
+        with self._lock:
+            return list(self._sizes.keys())

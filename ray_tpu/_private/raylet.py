@@ -1,0 +1,828 @@
+"""Raylet: per-node agent — scheduling, worker pool, object management.
+
+Analog of the reference's raylet (ray: src/ray/raylet/node_manager.h:119):
+
+- ClusterTaskManager (ray: scheduling/cluster_task_manager.h:33-42): pick a
+  feasible node from the GCS-synced cluster view (hybrid pack/spread policy),
+  spill to a peer raylet or queue locally.
+- LocalTaskManager (ray: local_task_manager.h:58): dependency-gated dispatch —
+  pull plasma args local, acquire resources, bind an idle worker, push task.
+- WorkerPool (ray: worker_pool.h:156): spawn/cache Python worker processes
+  keyed by job; dedicated workers for actors.
+- Object manager (ray: src/ray/object_manager/object_manager.h:117): chunked
+  peer-to-peer object transfer into the node-local shm store, pull admission.
+- Placement-group bundle resources via 2-phase prepare/commit
+  (ray: placement_group_resource_manager.h).
+
+TPU delta vs the reference: node resources advertise "TPU" chips plus ICI
+topology labels so STRICT_PACK bundles map onto one slice; there is no
+CUDA_VISIBLE_DEVICES analog — one worker process owns all local chips.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import object_store
+from ray_tpu._private.common import (
+    NodeInfo,
+    TaskSpec,
+    pick_node,
+    res_add,
+    res_fits,
+    res_sub,
+)
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.rpcio import Connection, RpcServer, connect
+
+logger = logging.getLogger(__name__)
+
+
+class _Worker:
+    def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes]):
+        self.proc = proc
+        self.job_id = job_id
+        self.conn: Optional[Connection] = None
+        self.client_id: Optional[str] = None
+        self.busy_with: Optional[bytes] = None  # task_id
+        self.actor_id: Optional[bytes] = None
+        self.registered = asyncio.get_running_loop().create_future()
+
+
+class _QueuedTask:
+    __slots__ = ("spec", "resources", "pending_deps", "worker")
+
+    def __init__(self, spec: TaskSpec, resources: Dict[str, float]):
+        self.spec = spec
+        self.resources = resources
+        self.pending_deps: Set[bytes] = set()
+        self.worker: Optional[_Worker] = None
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_host: str,
+        gcs_port: int,
+        session_dir: str,
+        resources: Dict[str, float],
+        labels: Dict[str, str] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        node_id: Optional[str] = None,
+    ):
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.gcs_host, self.gcs_port = gcs_host, gcs_port
+        self.session_dir = session_dir
+        self.host = host
+        self.server = RpcServer(self, host, port)
+        self.store_dir = os.path.join(session_dir, f"store_{self.node_id[:12]}")
+        self.store = object_store.LocalObjectStore(self.store_dir, cfg.object_store_memory)
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.gcs: Optional[Connection] = None
+        self.cluster_view: Dict[str, NodeInfo] = {}
+        self.peers: Dict[str, Connection] = {}
+        # Client registry: client_id -> Connection (drivers + workers on node)
+        self.clients: Dict[str, Connection] = {}
+        # Worker pool
+        self.idle_workers: deque = deque()
+        self.all_workers: Dict[int, _Worker] = {}  # pid -> worker
+        self.workers_by_client: Dict[str, _Worker] = {}
+        self.local_actors: Dict[bytes, _Worker] = {}
+        self.actor_addr_cache: Dict[bytes, tuple] = {}
+        # Task queues
+        self.waiting: Dict[bytes, _QueuedTask] = {}  # waiting on deps
+        self.ready: deque = deque()
+        self.running: Dict[bytes, _QueuedTask] = {}
+        self.dep_waiters: Dict[bytes, List[bytes]] = {}  # object -> task_ids
+        self.pg_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._rr = [0]
+        self._tasks: List[asyncio.Task] = []
+        self._dispatch_event = asyncio.Event()
+        self._stopping = False
+        self.port = None
+        # metrics
+        self.counters = {"tasks_dispatched": 0, "tasks_spilled": 0, "objects_pulled": 0}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self):
+        self.port = await self.server.start()
+        self.gcs = await connect(self.gcs_host, self.gcs_port, handler=self, name="gcs-conn")
+        info = {
+            "node_id": self.node_id,
+            "host": self.host,
+            "port": self.port,
+            "store_dir": self.store_dir,
+            "resources_total": self.resources_total,
+            "labels": self.labels,
+        }
+        reply = await self.gcs.request("register_node", info, timeout=cfg.gcs_rpc_timeout_s)
+        self._on_view(reply["nodes"])
+        self._tasks.append(asyncio.get_running_loop().create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(self._dispatch_loop()))
+        logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
+        return self.port
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.all_workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        await self.server.stop()
+        if self.gcs:
+            await self.gcs.close()
+
+    async def _heartbeat_loop(self):
+        while True:
+            try:
+                await self.gcs.request(
+                    "heartbeat",
+                    {
+                        "node_id": self.node_id,
+                        "resources_available": dict(self.resources_available),
+                    },
+                    timeout=cfg.gcs_rpc_timeout_s,
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+
+    # ------------------------------------------------------------------
+    # cluster view sync
+    # ------------------------------------------------------------------
+    def rpc_cluster_view(self, conn, view):
+        self._on_view(view)
+
+    def _on_view(self, view):
+        for n in view:
+            info = NodeInfo(
+                node_id=n["node_id"], host=n["host"], port=n["port"],
+                store_dir=n["store_dir"], resources_total=n["resources_total"],
+                labels=n.get("labels", {}),
+            )
+            info.resources_available = n["resources_available"]
+            info.alive = n["alive"]
+            self.cluster_view[n["node_id"]] = info
+        # Keep our own availability authoritative locally.
+        me = self.cluster_view.get(self.node_id)
+        if me:
+            me.resources_available = self.resources_available
+            me.resources_total = self.resources_total
+        self._dispatch_event.set()
+
+    async def _peer(self, node_id: str) -> Optional[Connection]:
+        conn = self.peers.get(node_id)
+        if conn and not conn.closed:
+            return conn
+        info = self.cluster_view.get(node_id)
+        if info is None or not info.alive:
+            return None
+        try:
+            conn = await connect(info.host, info.port, handler=self,
+                                 name=f"peer:{node_id[:8]}", retries=5)
+        except Exception:
+            return None
+        await conn.request("register_peer", {"node_id": self.node_id})
+        self.peers[node_id] = conn
+        return conn
+
+    async def rpc_register_peer(self, conn: Connection, p):
+        conn.meta.update(kind="peer", node_id=p["node_id"])
+        return {}
+
+    # ------------------------------------------------------------------
+    # client (core worker) registry
+    # ------------------------------------------------------------------
+    async def rpc_register_client(self, conn: Connection, p):
+        conn.meta.update(kind=p["kind"], client_id=p["client_id"], pid=p.get("pid"),
+                         job_id=p.get("job_id"))
+        self.clients[p["client_id"]] = conn
+        if p["kind"] == "worker":
+            w = self.all_workers.get(p.get("pid"))
+            if w is not None:
+                w.conn = conn
+                w.client_id = p["client_id"]
+                self.workers_by_client[p["client_id"]] = w
+                if not w.registered.done():
+                    w.registered.set_result(w)
+        return {"node_id": self.node_id, "store_dir": self.store_dir,
+                "resources_total": self.resources_total, "labels": self.labels}
+
+    def on_disconnect(self, conn: Connection):
+        kind = conn.meta.get("kind")
+        if kind in ("driver", "worker"):
+            cid = conn.meta.get("client_id")
+            self.clients.pop(cid, None)
+            if kind == "worker":
+                return self._on_worker_conn_lost(cid)
+        elif kind == "peer":
+            self.peers.pop(conn.meta.get("node_id"), None)
+
+    async def _on_worker_conn_lost(self, client_id: str):
+        w = self.workers_by_client.pop(client_id, None)
+        if w is None:
+            return
+        self.all_workers.pop(w.proc.pid, None)
+        try:
+            self.idle_workers.remove(w)
+        except ValueError:
+            pass
+        if w.actor_id is not None:
+            self.local_actors.pop(w.actor_id, None)
+            try:
+                await self.gcs.request(
+                    "actor_died",
+                    {"actor_id": w.actor_id, "intended": getattr(w, "kill_intended", False),
+                     "reason": f"actor worker exited (pid={w.proc.pid})"},
+                )
+            except Exception:
+                pass
+        if w.busy_with is not None:
+            qt = self.running.pop(w.busy_with, None)
+            if qt is not None:
+                res_add(self.resources_available, qt.resources)
+                await self._send_task_failure(
+                    qt.spec, f"worker died while executing (pid={w.proc.pid})",
+                    retriable=True,
+                )
+        self._dispatch_event.set()
+
+    # ------------------------------------------------------------------
+    # task submission path (ClusterTaskManager)
+    # ------------------------------------------------------------------
+    async def rpc_submit_task(self, conn: Connection, p):
+        spec: TaskSpec = p["spec"]
+        if spec.actor_id is not None and not spec.actor_creation:
+            await self._route_actor_task(spec, p.get("actor_addr"))
+            return {}
+        await self._schedule_or_queue(spec, depth=p.get("depth", 0))
+        return {}
+
+    async def rpc_spill_submit(self, conn: Connection, p):
+        await self._schedule_or_queue(p["spec"], depth=p.get("depth", 0))
+        return {}
+
+    async def _schedule_or_queue(self, spec: TaskSpec, depth: int = 0):
+        demand = spec.resources
+        nodes = list(self.cluster_view.values())
+        target = pick_node(nodes, demand, spec.scheduling, self.node_id, self._rr,
+                           cfg.scheduler_spread_threshold)
+        if target is None:
+            # Infeasible now: queue locally, retried by dispatch loop.
+            target = self.node_id
+        if target != self.node_id and depth < cfg.max_spillback_depth:
+            peer = await self._peer(target)
+            if peer is not None:
+                try:
+                    await peer.request("spill_submit", {"spec": spec, "depth": depth + 1})
+                    self.counters["tasks_spilled"] += 1
+                    return
+                except Exception:
+                    pass
+        self._queue_local(spec)
+
+    def _queue_local(self, spec: TaskSpec):
+        qt = _QueuedTask(spec, dict(spec.resources))
+        missing = self._missing_deps(spec)
+        if missing:
+            qt.pending_deps = set(missing)
+            self.waiting[spec.task_id] = qt
+            for oid in missing:
+                self.dep_waiters.setdefault(oid, []).append(spec.task_id)
+                asyncio.get_running_loop().create_task(self._pull_for_dep(oid))
+        else:
+            self.ready.append(qt)
+            self._dispatch_event.set()
+
+    def _missing_deps(self, spec: TaskSpec) -> List[bytes]:
+        missing = []
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a[0] == "r":
+                oid = a[1]
+                if not self.store.contains(ObjectID(oid)):
+                    missing.append(oid)
+        return missing
+
+    async def _pull_for_dep(self, oid: bytes):
+        ok = await self._ensure_local(oid)
+        waiters = self.dep_waiters.pop(oid, [])
+        for tid in waiters:
+            qt = self.waiting.get(tid)
+            if qt is None:
+                continue
+            if not ok:
+                del self.waiting[tid]
+                await self._send_task_failure(
+                    qt.spec, f"failed to fetch dependency {oid.hex()[:16]}", retriable=True
+                )
+                continue
+            qt.pending_deps.discard(oid)
+            if not qt.pending_deps:
+                del self.waiting[tid]
+                self.ready.append(qt)
+                self._dispatch_event.set()
+
+    # ------------------------------------------------------------------
+    # dispatch loop (LocalTaskManager)
+    # ------------------------------------------------------------------
+    async def _dispatch_loop(self):
+        while True:
+            await self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            again = deque()
+            while self.ready:
+                qt = self.ready.popleft()
+                if not res_fits(qt.resources, self.resources_available):
+                    # If infeasible on this node entirely, retry cluster-wide
+                    # scheduling after a delay (another node may gain the
+                    # resource, e.g. a PG bundle commit); else wait locally.
+                    if not res_fits(qt.resources, self.resources_total):
+                        asyncio.get_running_loop().create_task(
+                            self._reschedule_later(qt.spec)
+                        )
+                    else:
+                        again.append(qt)
+                    continue
+                w = await self._pop_worker(qt.spec)
+                if w is None:
+                    again.append(qt)
+                    continue
+                res_sub(self.resources_available, qt.resources)
+                qt.worker = w
+                w.busy_with = qt.spec.task_id
+                self.running[qt.spec.task_id] = qt
+                self.counters["tasks_dispatched"] += 1
+                asyncio.get_running_loop().create_task(self._run_on_worker(qt, w))
+            self.ready.extend(again)
+            if again:
+                await asyncio.sleep(0.01)
+                self._dispatch_event.set()
+
+    async def _reschedule_later(self, spec: TaskSpec):
+        await asyncio.sleep(0.5)
+        await self._schedule_or_queue(spec, depth=0)
+
+    async def _run_on_worker(self, qt: _QueuedTask, w: _Worker):
+        try:
+            result = await w.conn.request("execute_task", {"spec": qt.spec})
+        except Exception as e:
+            result = None
+            logger.warning("dispatch to worker failed: %s", e)
+        # If the worker died, _on_worker_conn_lost already popped the task and
+        # returned its resources — only release them if we pop it ourselves.
+        popped = self.running.pop(qt.spec.task_id, None)
+        if popped is not None:
+            res_add(self.resources_available, qt.resources)
+        w.busy_with = None
+        if result is None:
+            # worker died; _on_worker_conn_lost handles failure notification.
+            self._dispatch_event.set()
+            return
+        if w.actor_id is None and not w.conn.closed:
+            self.idle_workers.append(w)
+        await self._deliver_result(qt.spec, result)
+        self._dispatch_event.set()
+
+    async def _deliver_result(self, spec: TaskSpec, result: dict):
+        """Route a completed task's result notification to the owner."""
+        for oid in result.get("stored_objects", ()):
+            self.store.register_external(ObjectID(oid))
+            try:
+                await self.gcs.request(
+                    "add_object_location", {"object_id": oid, "node_id": self.node_id}
+                )
+            except Exception:
+                pass
+        payload = {
+            "task_id": spec.task_id,
+            "results": result.get("results"),
+            "error": result.get("error"),
+            "error_value": result.get("error_value"),
+            "app_error": result.get("app_error", False),
+            "retriable": result.get("retriable", False),
+            "attempt": spec.attempt,
+        }
+        await self._route_to_owner(spec.owner, "task_result", payload)
+
+    async def _route_to_owner(self, owner: tuple, method: str, payload):
+        node_id, client_id = owner
+        if node_id == self.node_id:
+            conn = self.clients.get(client_id)
+            if conn is not None and not conn.closed:
+                try:
+                    await conn.notify(method, payload)
+                except Exception:
+                    pass
+            return
+        peer = await self._peer(node_id)
+        if peer is not None:
+            try:
+                await peer.notify(
+                    "route_to_client",
+                    {"client_id": client_id, "method": method, "payload": payload},
+                )
+            except Exception:
+                pass
+
+    async def rpc_route_to_client(self, conn: Connection, p):
+        c = self.clients.get(p["client_id"])
+        if c is not None and not c.closed:
+            try:
+                await c.notify(p["method"], p["payload"])
+            except Exception:
+                pass
+
+    async def _send_task_failure(self, spec: TaskSpec, reason: str, retriable: bool):
+        await self._route_to_owner(
+            spec.owner,
+            "task_result",
+            {"task_id": spec.task_id, "results": None, "error": reason,
+             "system_error": True, "retriable": retriable, "attempt": spec.attempt},
+        )
+
+    # ------------------------------------------------------------------
+    # worker pool
+    # ------------------------------------------------------------------
+    async def _pop_worker(self, spec: TaskSpec) -> Optional[_Worker]:
+        while self.idle_workers:
+            w = self.idle_workers.popleft()
+            if w.conn is not None and not w.conn.closed:
+                return w
+        n_alive = len(self.all_workers)
+        if n_alive >= cfg.num_workers_soft_limit:
+            return None
+        return await self._start_worker(spec.job_id)
+
+    async def _start_worker(self, job_id: Optional[bytes]) -> Optional[_Worker]:
+        from ray_tpu._private.node import package_env
+
+        env = package_env()
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_RAYLET_PORT"] = str(self.port)
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
+        env["RAY_TPU_STORE_DIR"] = self.store_dir
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Workers must not grab the TPU unless a task asks for it; JAX inits
+        # lazily so this is safe, but keep workers on CPU by default for
+        # control-plane work (the trainer backend overrides per worker group).
+        log_path = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_path, exist_ok=True)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=open(os.path.join(log_path, f"worker-{time.time():.0f}-{os.getpid()}.out"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+        w = _Worker(proc, job_id)
+        self.all_workers[proc.pid] = w
+        try:
+            await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            logger.error("worker %s failed to register", proc.pid)
+            proc.kill()
+            self.all_workers.pop(proc.pid, None)
+            return None
+        return w
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def rpc_create_actor(self, conn: Connection, p):
+        spec: TaskSpec = p["spec"]
+        if not res_fits(spec.resources, self.resources_available):
+            return {"rejected": True}
+        w = await self._pop_worker(spec)
+        if w is None:
+            return {"rejected": True}
+        res_sub(self.resources_available, spec.resources)
+        try:
+            reply = await w.conn.request("become_actor", {"spec": spec},
+                                         timeout=cfg.gcs_rpc_timeout_s)
+        except Exception as e:
+            res_add(self.resources_available, spec.resources)
+            return {"rejected": True, "detail": str(e)}
+        if reply.get("error"):
+            res_add(self.resources_available, spec.resources)
+            self.idle_workers.append(w)
+            return {"error": reply["error"]}
+        w.actor_id = spec.actor_id
+        w.actor_resources = dict(spec.resources)
+        self.local_actors[spec.actor_id] = w
+        return {"worker_client_id": w.client_id}
+
+    async def rpc_kill_actor(self, conn: Connection, p):
+        w = self.local_actors.get(p["actor_id"])
+        if w is None:
+            return {}
+        w.kill_intended = True
+        res_add(self.resources_available, getattr(w, "actor_resources", {}))
+        try:
+            w.proc.terminate()
+        except Exception:
+            pass
+        return {}
+
+    async def _route_actor_task(self, spec: TaskSpec, actor_addr: Optional[tuple]):
+        # Local actor: push straight to its worker.
+        w = self.local_actors.get(spec.actor_id)
+        if w is not None and w.conn is not None and not w.conn.closed:
+            asyncio.get_running_loop().create_task(self._run_actor_task(spec, w))
+            return
+        addr = actor_addr or self.actor_addr_cache.get(spec.actor_id)
+        if addr is None or addr[0] == self.node_id:
+            try:
+                table = await self.gcs.request(
+                    "wait_actor_alive", {"actor_id": spec.actor_id, "timeout": 30.0}
+                )
+            except Exception:
+                table = None
+            if table is None or table["state"] == "DEAD" or not table.get("address"):
+                await self._route_to_owner(
+                    spec.owner, "task_result",
+                    {"task_id": spec.task_id, "results": None,
+                     "error": f"actor {spec.actor_id.hex()[:16]} is dead"
+                     if table and table["state"] == "DEAD" else "actor unavailable",
+                     "actor_dead": bool(table and table["state"] == "DEAD"),
+                     "system_error": True, "retriable": False, "attempt": spec.attempt},
+                )
+                return
+            addr = tuple(table["address"])
+        self.actor_addr_cache[spec.actor_id] = addr
+        if addr[0] == self.node_id:
+            await self._route_actor_task(spec, None)
+            return
+        peer = await self._peer(addr[0])
+        if peer is None:
+            self.actor_addr_cache.pop(spec.actor_id, None)
+            await self._send_task_failure(spec, "actor node unreachable", retriable=True)
+            return
+        try:
+            await peer.request("submit_task", {"spec": spec, "actor_addr": addr})
+        except Exception:
+            self.actor_addr_cache.pop(spec.actor_id, None)
+            await self._send_task_failure(spec, "actor node unreachable", retriable=True)
+
+    async def _run_actor_task(self, spec: TaskSpec, w: _Worker):
+        try:
+            result = await w.conn.request("execute_task", {"spec": spec})
+        except Exception:
+            # actor worker died mid-task; GCS failure path notifies owner of
+            # actor death; report retriable failure for this call.
+            await self._send_task_failure(spec, "actor worker died", retriable=True)
+            return
+        await self._deliver_result(spec, result)
+
+    # ------------------------------------------------------------------
+    # object plane
+    # ------------------------------------------------------------------
+    async def rpc_register_put(self, conn: Connection, p):
+        oid = p["object_id"]
+        self.store.register_external(ObjectID(oid))
+        try:
+            await self.gcs.request(
+                "add_object_location", {"object_id": oid, "node_id": self.node_id}
+            )
+        except Exception:
+            pass
+        return {}
+
+    async def rpc_pull_object(self, conn: Connection, p):
+        ok = await self._ensure_local(p["object_id"])
+        return {"ok": ok}
+
+    async def _ensure_local(self, oid_bytes: bytes) -> bool:
+        oid = ObjectID(oid_bytes)
+        if self.store.contains(oid):
+            return True
+        fut = self._pulls_inflight.get(oid_bytes)
+        if fut is not None:
+            return await fut
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[oid_bytes] = fut
+        try:
+            ok = await self._do_pull(oid)
+            fut.set_result(ok)
+            return ok
+        except Exception as e:
+            fut.set_result(False)
+            logger.warning("pull of %s failed: %s", oid_bytes.hex()[:16], e)
+            return False
+        finally:
+            self._pulls_inflight.pop(oid_bytes, None)
+
+    async def _do_pull(self, oid: ObjectID) -> bool:
+        deadline = time.monotonic() + cfg.object_pull_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                locs = await self.gcs.request(
+                    "get_object_locations",
+                    {"object_id": oid.binary(), "wait": True,
+                     "timeout": min(5.0, deadline - time.monotonic())},
+                )
+            except Exception:
+                locs = []
+            locs = [l for l in locs if l != self.node_id]
+            if not locs and self.store.contains(oid):
+                return True
+            for node_id in locs:
+                peer = await self._peer(node_id)
+                if peer is None:
+                    continue
+                if await self._fetch_from(peer, oid):
+                    self.counters["objects_pulled"] += 1
+                    try:
+                        await self.gcs.request(
+                            "add_object_location",
+                            {"object_id": oid.binary(), "node_id": self.node_id},
+                        )
+                    except Exception:
+                        pass
+                    return True
+            if self.store.contains(oid):
+                return True
+            await asyncio.sleep(0.1)
+        return False
+
+    async def _fetch_from(self, peer: Connection, oid: ObjectID) -> bool:
+        chunk = cfg.object_transfer_chunk_bytes
+        try:
+            first = await peer.request(
+                "fetch_object", {"object_id": oid.binary(), "offset": 0, "chunk": chunk},
+                timeout=cfg.gcs_rpc_timeout_s,
+            )
+        except Exception:
+            return False
+        if not first.get("exists"):
+            return False
+        total = first["total"]
+        metadata = first["metadata"]
+        parts = [first["data"]]
+        got = len(first["data"])
+        while got < total:
+            try:
+                nxt = await peer.request(
+                    "fetch_object",
+                    {"object_id": oid.binary(), "offset": got, "chunk": chunk},
+                    timeout=cfg.gcs_rpc_timeout_s,
+                )
+            except Exception:
+                return False
+            if not nxt.get("exists"):
+                return False
+            parts.append(nxt["data"])
+            got += len(nxt["data"])
+        self.store.put(oid, metadata, parts, total)
+        return True
+
+    async def rpc_fetch_object(self, conn: Connection, p):
+        oid = ObjectID(p["object_id"])
+        buf = self.store.get(oid)
+        if buf is None:
+            return {"exists": False}
+        try:
+            total = len(buf.data)
+            off = p["offset"]
+            data = bytes(buf.data[off : off + p["chunk"]])
+            out = {"exists": True, "total": total, "data": data}
+            if off == 0:
+                out["metadata"] = buf.metadata
+            return out
+        finally:
+            buf.release()
+
+    def rpc_delete_object(self, conn: Connection, p):
+        self.store.delete(ObjectID(p["object_id"]))
+
+    async def rpc_fetch_owned_routed(self, conn: Connection, p):
+        """Route a borrower's small-object fetch to the owning core worker
+        (simplified owner-based object directory lookup)."""
+        node_id, client_id = tuple(p["owner"])
+        if node_id == self.node_id:
+            c = self.clients.get(client_id)
+            if c is None or c.closed:
+                return {"unknown": True, "owner_dead": True}
+            try:
+                return await c.request(
+                    "fetch_owned", {"object_id": p["object_id"]}, timeout=10.0
+                )
+            except Exception:
+                return {"unknown": True}
+        peer = await self._peer(node_id)
+        if peer is None:
+            return {"unknown": True, "owner_dead": True}
+        try:
+            return await peer.request(
+                "fetch_owned_routed",
+                {"owner": (node_id, client_id), "object_id": p["object_id"]},
+                timeout=10.0,
+            )
+        except Exception:
+            return {"unknown": True}
+
+    async def rpc_free_object(self, conn: Connection, p):
+        try:
+            await self.gcs.request("free_object", {"object_id": p["object_id"]})
+        except Exception:
+            pass
+        return {}
+
+    # ------------------------------------------------------------------
+    # placement groups (bundle resources; 2-phase)
+    # ------------------------------------------------------------------
+    async def rpc_pg_prepare(self, conn: Connection, p):
+        from ray_tpu._private.common import rewrite_resources_for_pg
+
+        resources = p["resources"]
+        if not res_fits(resources, self.resources_available):
+            return {"ok": False}
+        res_sub(self.resources_available, resources)
+        named = rewrite_resources_for_pg(resources, p["pg_id"], p["bundle_index"])
+        self.pg_bundles[(p["pg_id"], p["bundle_index"])] = {
+            "original": resources, "named": named, "committed": False,
+        }
+        res_add(self.resources_total, named)
+        res_add(self.resources_available, named)
+        self._dispatch_event.set()
+        return {"ok": True}
+
+    async def rpc_pg_commit(self, conn: Connection, p):
+        b = self.pg_bundles.get((p["pg_id"], p["bundle_index"]))
+        if b:
+            b["committed"] = True
+        return {"ok": True}
+
+    def rpc_pg_cancel(self, conn: Connection, p):
+        self._return_bundle(p["pg_id"], p["bundle_index"])
+
+    def rpc_pg_return(self, conn: Connection, p):
+        self._return_bundle(p["pg_id"], p["bundle_index"])
+
+    def _return_bundle(self, pg_id: str, bundle_index: int):
+        b = self.pg_bundles.pop((pg_id, bundle_index), None)
+        if not b:
+            return
+        for k, v in b["named"].items():
+            self.resources_total[k] = max(0.0, self.resources_total.get(k, 0.0) - v)
+            self.resources_available[k] = max(
+                0.0, self.resources_available.get(k, 0.0) - v
+            )
+            if self.resources_total.get(k, 0.0) <= 0:
+                self.resources_total.pop(k, None)
+                self.resources_available.pop(k, None)
+        res_add(self.resources_available, b["original"])
+        self._dispatch_event.set()
+
+    # ------------------------------------------------------------------
+    # misc / introspection
+    # ------------------------------------------------------------------
+    async def rpc_node_stats(self, conn: Connection, _):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": len(self.all_workers),
+            "num_idle_workers": len(self.idle_workers),
+            "queued": len(self.ready) + len(self.waiting),
+            "running": len(self.running),
+            "store_used_bytes": self.store.used_bytes(),
+            "counters": dict(self.counters),
+        }
+
+    async def rpc_cancel_task(self, conn: Connection, p):
+        tid = p["task_id"]
+        qt = self.waiting.pop(tid, None)
+        if qt is None:
+            for i, q in enumerate(self.ready):
+                if q.spec.task_id == tid:
+                    qt = q
+                    del self.ready[i]
+                    break
+        if qt is not None:
+            await self._route_to_owner(
+                qt.spec.owner, "task_result",
+                {"task_id": tid, "results": None, "error": "task cancelled",
+                 "cancelled": True, "retriable": False, "attempt": qt.spec.attempt},
+            )
+            return {"cancelled": True}
+        running = self.running.get(tid)
+        if running is not None and p.get("force") and running.worker is not None:
+            running.worker.proc.terminate()
+            return {"cancelled": True}
+        return {"cancelled": False}

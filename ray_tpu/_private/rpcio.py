@@ -1,0 +1,268 @@
+"""Bidirectional async RPC substrate.
+
+Plays the role of the reference's gRPC + asio layer (ray: src/ray/rpc/,
+src/ray/common/asio/): every control-plane process (GCS, raylet, core worker)
+runs one asyncio loop; peers hold persistent duplex connections over which
+either side can issue requests or one-way notifications. Messages are
+length-prefixed pickles: ``[4B len][pickle((msg_id, kind, method, payload))]``.
+
+This is the control plane only — bulk object bytes move through the shm store
+(intra-node) and the object-manager chunk protocol (inter-node), mirroring the
+reference's separation of gRPC control from plasma/object-manager data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import threading
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+KIND_REQ = 0
+KIND_RESP = 1
+KIND_ERR = 2
+KIND_NOTIFY = 3
+
+_HDR = 4
+_MAX_MSG = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """One duplex peer connection. Owned by exactly one event loop."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, reader, writer, handler: Optional[object] = None, name: str = "?"):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msg_ids = itertools.count(1)
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self.on_close: Optional[Callable] = None
+        self._recv_task: Optional[asyncio.Task] = None
+        # Arbitrary peer metadata attached at registration time.
+        self.meta: Dict[str, Any] = {}
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        return self._recv_task
+
+    async def _send(self, msg_id: int, kind: int, method: str, payload):
+        data = pickle.dumps((msg_id, kind, method, payload), protocol=5)
+        async with self._send_lock:
+            self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+            await self.writer.drain()
+
+    async def request(self, method: str, payload=None, timeout: float = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self._send(msg_id, KIND_REQ, method, payload)
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, method: str, payload=None):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        await self._send(0, KIND_NOTIFY, method, payload)
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(_HDR)
+                n = int.from_bytes(hdr, "little")
+                if n > _MAX_MSG:
+                    raise RpcError(f"oversized message: {n}")
+                data = await self.reader.readexactly(n)
+                msg_id, kind, method, payload = pickle.loads(data)
+                if kind == KIND_RESP:
+                    fut = self._pending.get(msg_id)
+                    if fut and not fut.done():
+                        fut.set_result(payload)
+                elif kind == KIND_ERR:
+                    fut = self._pending.get(msg_id)
+                    if fut and not fut.done():
+                        fut.set_exception(RpcError(payload))
+                else:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(msg_id, kind, method, payload)
+                    )
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("rpc recv loop error on %s", self.name)
+        finally:
+            await self._do_close()
+
+    async def _dispatch(self, msg_id: int, kind: int, method: str, payload):
+        handler = self.handler
+        fn = getattr(handler, f"rpc_{method}", None) if handler else None
+        if fn is None:
+            if kind == KIND_REQ:
+                await self._send(msg_id, KIND_ERR, method, f"no handler for {method!r}")
+            else:
+                logger.warning("%s: dropping notify %r (no handler)", self.name, method)
+            return
+        try:
+            result = fn(self, payload)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if kind == KIND_REQ:
+                await self._send(msg_id, KIND_RESP, method, result)
+        except (ConnectionLost, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception as e:
+            logger.exception("handler %s failed on %s", method, self.name)
+            if kind == KIND_REQ:
+                try:
+                    await self._send(msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
+    async def _do_close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                result = self.on_close(self)
+                if asyncio.iscoroutine(result):
+                    await result
+            except Exception:
+                logger.exception("on_close callback failed for %s", self.name)
+
+    async def close(self):
+        if self._recv_task:
+            self._recv_task.cancel()
+        await self._do_close()
+
+    @property
+    def closed(self):
+        return self._closed
+
+
+class RpcServer:
+    """Asyncio TCP server; each accepted peer becomes a Connection with the
+    given handler. The handler may implement ``on_connection(conn)`` /
+    ``on_disconnect(conn)``."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set = set()
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._accept, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _accept(self, reader, writer):
+        conn = Connection(reader, writer, self.handler, name=f"server:{self.port}")
+        self.connections.add(conn)
+
+        def _closed(c):
+            self.connections.discard(c)
+            cb = getattr(self.handler, "on_disconnect", None)
+            if cb:
+                return cb(c)
+
+        conn.on_close = _closed
+        cb = getattr(self.handler, "on_connection", None)
+        if cb:
+            result = cb(conn)
+            if asyncio.iscoroutine(result):
+                await result
+        conn.start()
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(host: str, port: int, handler=None, name: str = "client",
+                  retries: int = 30, retry_delay: float = 0.1) -> Connection:
+    last = None
+    for _ in range(retries):
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            conn = Connection(reader, writer, handler, name=name)
+            conn.start()
+            return conn
+        except (ConnectionRefusedError, OSError) as e:
+            last = e
+            await asyncio.sleep(retry_delay)
+    raise ConnectionLost(f"cannot connect to {host}:{port}: {last}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread, for sync callers.
+
+    This is the analog of the reference's per-process io_context thread
+    (ray: src/ray/common/asio/instrumented_io_context.h) embedded in a
+    synchronous Python driver/worker.
+    """
+
+    def __init__(self, name: str = "rpc-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float = None):
+        """Run coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _cancel_all():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+
+        try:
+            self.loop.call_soon_threadsafe(_cancel_all)
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(timeout=5)
+        except Exception:
+            pass

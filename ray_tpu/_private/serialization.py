@@ -1,0 +1,124 @@
+"""Value (de)serialization for the object plane.
+
+Analog of the reference's SerializationContext
+(ray: python/ray/_private/serialization.py:108): cloudpickle for closures +
+pickle protocol 5 out-of-band buffers so numpy / jax host arrays round-trip
+through the shm store without copies on the read side. A serialized value is
+
+  metadata: pickled {"fmt": ..., "buf_lens": [...], "nested_refs": [...]}
+  data:     [8B pickle_len][pickle bytes][buffer 0][buffer 1]...
+
+Errors are serialized with fmt="error" so ``get`` re-raises on the caller
+(ray: python/ray/exceptions.py RayTaskError semantics).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import object_ref as _object_ref
+
+FMT_PICKLE5 = b"P5"
+FMT_ERROR = b"ER"
+FMT_RAW = b"RW"  # raw bytes payload, zero-copy
+
+
+class SerializedValue:
+    __slots__ = ("metadata", "buffers", "total_data_len", "nested_refs")
+
+    def __init__(self, metadata, buffers, total_data_len, nested_refs):
+        self.metadata = metadata
+        self.buffers = buffers
+        self.total_data_len = total_data_len
+        self.nested_refs = nested_refs
+
+    def to_bytes(self) -> bytes:
+        return b"".join(bytes(b) for b in self.buffers)
+
+
+def _pack(fmt: bytes, pickled: bytes, oob: List, nested_refs) -> SerializedValue:
+    buf_lens = [len(b) for b in oob]
+    meta = pickle.dumps(
+        {"fmt": fmt, "buf_lens": buf_lens, "nested_refs": nested_refs}, protocol=5
+    )
+    buffers = [len(pickled).to_bytes(8, "little"), pickled] + oob
+    total = 8 + len(pickled) + sum(buf_lens)
+    return SerializedValue(meta, buffers, total, nested_refs)
+
+
+def serialize(value: Any) -> SerializedValue:
+    if isinstance(value, bytes):
+        meta = pickle.dumps({"fmt": FMT_RAW, "buf_lens": [], "nested_refs": []})
+        return SerializedValue(meta, [value], len(value), [])
+    oob: List = []
+
+    def buffer_callback(pb: pickle.PickleBuffer):
+        view = pb.raw()
+        if view.nbytes >= 512:  # keep tiny buffers in-band
+            oob.append(view)
+            return False
+        return True
+
+    _object_ref.start_ref_capture()
+    try:
+        pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+        nested = [(r.binary(), r.owner) for r in _object_ref.captured_refs()]
+    finally:
+        _object_ref.stop_ref_capture()
+    return _pack(FMT_PICKLE5, pickled, oob, nested)
+
+
+def serialize_error(exc: BaseException, task_info: str = "") -> SerializedValue:
+    import traceback
+
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        payload = cloudpickle.dumps((exc, tb, task_info), protocol=5)
+    except Exception:
+        payload = cloudpickle.dumps(
+            (RuntimeError(f"{type(exc).__name__}: {exc}"), tb, task_info), protocol=5
+        )
+    return _pack(FMT_ERROR, payload, [], [])
+
+
+class TaskError(Exception):
+    """Wraps an exception raised inside a task, carrying the remote traceback.
+
+    Analog of ray.exceptions.RayTaskError: re-raised at every ``get`` site.
+    """
+
+    def __init__(self, cause: BaseException, remote_traceback: str, task_info: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        self.task_info = task_info
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (
+            f"{type(self.cause).__name__}: {self.cause}\n"
+            f"--- remote traceback ({self.task_info}) ---\n{self.remote_traceback}"
+        )
+
+
+def deserialize(metadata: bytes, data) -> Any:
+    """Deserialize from metadata + a bytes-like data view (zero-copy capable)."""
+    meta = pickle.loads(metadata)
+    fmt = meta["fmt"]
+    view = memoryview(data)
+    if fmt == FMT_RAW:
+        return bytes(view)
+    plen = int.from_bytes(bytes(view[:8]), "little")
+    pickled = view[8 : 8 + plen]
+    offset = 8 + plen
+    buffers = []
+    for blen in meta["buf_lens"]:
+        buffers.append(view[offset : offset + blen])
+        offset += blen
+    value = pickle.loads(bytes(pickled), buffers=buffers)
+    if fmt == FMT_ERROR:
+        exc, tb, info = value
+        raise TaskError(exc, tb, info)
+    return value

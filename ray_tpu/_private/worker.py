@@ -1,0 +1,749 @@
+"""Core worker: the per-process runtime embedded in drivers and workers.
+
+Analog of the reference's CoreWorker (ray: src/ray/core_worker/core_worker.h:284):
+task submission with submitter-side dependency resolution
+(ray: transport/dependency_resolver.h — owned in-memory args are awaited and
+inlined before the lease request; plasma refs are left for the raylet), an
+in-process memory store for small objects (ray: memory_store.h:43), the plasma
+provider for shm objects (ray: plasma_store_provider.h:88), owner-side retry
+bookkeeping (ray: task_manager.h:173), a simplified reference counter
+(ray: reference_count.h:61), and per-caller ordered actor submission
+(ray: sequential_actor_submit_queue.h).
+
+Sync user code runs on the main/executor threads; all IO rides a dedicated
+asyncio loop thread (rpcio.EventLoopThread), mirroring the reference's
+io_context-per-process model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import object_store, serialization
+from ray_tpu._private.common import SchedulingStrategy, TaskSpec, rewrite_resources_for_pg
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpcio import Connection, EventLoopThread, connect
+
+logger = logging.getLogger(__name__)
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class ActorDiedError(RuntimeError):
+    pass
+
+
+class TaskCancelledError(RuntimeError):
+    pass
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        raylet_host: str,
+        raylet_port: int,
+        gcs_host: str,
+        gcs_port: int,
+        is_driver: bool,
+        job_id: Optional[bytes] = None,
+        namespace: Optional[str] = None,
+    ):
+        self.client_id = WorkerID.from_random().hex()
+        self.is_driver = is_driver
+        self.namespace = namespace or "default"
+        self.executor = None  # set by TaskExecutor on worker processes
+        self.io = EventLoopThread(name=f"coreworker-io-{self.client_id[:6]}")
+        self.raylet: Connection = self.io.run(
+            connect(raylet_host, raylet_port, handler=self, name="raylet-conn")
+        )
+        self.gcs: Connection = self.io.run(
+            connect(gcs_host, gcs_port, handler=self, name="gcs-conn")
+        )
+        self.gcs_addr = (gcs_host, gcs_port)
+        if is_driver and job_id is None:
+            job_id = self.io.run(
+                self.gcs.request("register_job", {"namespace": self.namespace,
+                                                  "driver": {"pid": os.getpid()}})
+            )["job_id"]
+        self.job_id = job_id or JobID.from_int(0).binary()
+        self.io.run(
+            self.gcs.request(
+                "register_client",
+                {"client_id": self.client_id, "job_id": self.job_id,
+                 "is_driver": is_driver},
+            )
+        )
+        reply = self.io.run(
+            self.raylet.request(
+                "register_client",
+                {"client_id": self.client_id,
+                 "kind": "driver" if is_driver else "worker",
+                 "job_id": self.job_id, "pid": os.getpid()},
+            )
+        )
+        self.node_id: str = reply["node_id"]
+        self.store_dir: str = reply["store_dir"]
+        self.node_resources: Dict[str, float] = reply.get("resources_total", {})
+        self.node_labels: Dict[str, str] = reply.get("labels", {})
+        self.addr = (self.node_id, self.client_id)
+        if is_driver:
+            self.task_id = TaskID.for_driver(JobID(self.job_id))
+        else:
+            self.task_id = TaskID.for_task(JobID(self.job_id))
+        # owner-side state
+        self._lock = threading.Lock()
+        self._futures: Dict[bytes, concurrent.futures.Future] = {}
+        self._memory_store: Dict[bytes, Tuple[bytes, bytes]] = {}
+        self._pinned_buffers: Dict[bytes, object_store.ObjectBuffer] = {}
+        self._specs_inflight: Dict[bytes, TaskSpec] = {}
+        self._put_index = 0
+        self._local_refs: Dict[bytes, int] = {}
+        self._submitted_refs: Dict[bytes, int] = {}
+        self._owned: set = set()
+        self._borrowed: set = set()
+        # Owned objects whose refs were serialized out of this process: a
+        # borrower may resolve them at any time, so never auto-free them
+        # (conservative stand-in for the reference's borrower protocol,
+        # ray: reference_count.h WaitForRefRemoved).
+        self._escaped: set = set()
+        self._actor_seq: Dict[bytes, int] = {}
+        self._pubsub_handlers: Dict[str, list] = {}
+        self.connected = True
+
+    # ------------------------------------------------------------------
+    # argument encoding / submitter-side dependency resolution
+    # ------------------------------------------------------------------
+    def _encode_value(self, value: Any) -> Tuple:
+        sv = serialization.serialize(value)
+        if sv.nested_refs:
+            self.pin_escaped(sv.nested_refs)
+        if sv.total_data_len <= cfg.max_direct_call_object_size:
+            return ("v", sv.metadata, sv.to_bytes())
+        ref = self._put_serialized(sv)
+        # Keep the implicit put alive until the consuming task finishes.
+        self._submitted_refs[ref.binary()] = self._submitted_refs.get(ref.binary(), 0) + 1
+        return ("r", ref.binary(), ref.owner)
+
+    def _encode_slots(self, args, kwargs):
+        """Encode values eagerly; refs become ('pending', ref) placeholders."""
+        enc_args = [
+            ("pending", a) if isinstance(a, ObjectRef) else self._encode_value(a)
+            for a in args
+        ]
+        enc_kwargs = {
+            k: (("pending", v) if isinstance(v, ObjectRef) else self._encode_value(v))
+            for k, v in (kwargs or {}).items()
+        }
+        pending = [s[1] for s in enc_args if s[0] == "pending"]
+        pending += [s[1] for s in enc_kwargs.values() if s[0] == "pending"]
+        return enc_args, enc_kwargs, pending
+
+    def _finalize_slot(self, slot):
+        if slot[0] != "pending":
+            return slot
+        ref: ObjectRef = slot[1]
+        with self._lock:
+            inline = self._memory_store.get(ref.binary())
+        if inline is not None:
+            return ("v", inline[0], inline[1])
+        self._submitted_refs[ref.binary()] = self._submitted_refs.get(ref.binary(), 0) + 1
+        return ("r", ref.binary(), ref.owner or self.addr)
+
+    async def _submit_when_ready(self, spec: TaskSpec, enc_args, enc_kwargs,
+                                 pending: List[ObjectRef]):
+        try:
+            for ref in pending:
+                fut = self.future_for(ref)
+                await asyncio.wait_for(
+                    asyncio.wrap_future(fut), cfg.object_pull_timeout_s * 4
+                )
+        except Exception as e:
+            self._fail_returns(spec, f"dependency resolution failed: {e}")
+            return
+        spec.args = [self._finalize_slot(s) for s in enc_args]
+        spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+        try:
+            await self.raylet.request("submit_task", {"spec": spec})
+        except Exception as e:
+            self._fail_returns(spec, f"task submission failed: {e}")
+
+    def _fail_returns(self, spec: TaskSpec, message: str):
+        sv = serialization.serialize_error(RuntimeError(message), spec.name)
+        tid = TaskID(spec.task_id)
+        with self._lock:
+            self._specs_inflight.pop(spec.task_id, None)
+        for i in range(spec.num_returns):
+            oid = ObjectID.from_index(tid, i + 1)
+            self._resolve_inline(oid.binary(), sv.metadata, sv.to_bytes())
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit_task(
+        self,
+        func,
+        args=(),
+        kwargs=None,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        scheduling: Optional[SchedulingStrategy] = None,
+        max_retries: int = 3,
+        retry_exceptions: bool = False,
+        name: str = "",
+        func_blob: Optional[bytes] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        import cloudpickle
+
+        task_id = TaskID.for_task(JobID(self.job_id))
+        scheduling = scheduling or SchedulingStrategy()
+        resources = dict(resources if resources is not None else {"CPU": 1.0})
+        if scheduling.kind == "PLACEMENT_GROUP":
+            resources = rewrite_resources_for_pg(
+                resources, scheduling.pg_id, scheduling.pg_bundle_index
+            )
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id,
+            name=name or getattr(func, "__name__", "task"),
+            func_blob=func_blob if func_blob is not None else cloudpickle.dumps(func),
+            method_name=None,
+            num_returns=num_returns,
+            resources=resources,
+            scheduling=scheduling,
+            owner=self.addr,
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            caller_id=self.client_id.encode(),
+            runtime_env=runtime_env,
+        )
+        refs = self._register_returns(spec)
+        self.io.call_soon(self._submit_when_ready(spec, enc_args, enc_kwargs, pending))
+        return refs
+
+    def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = []
+        task_id = TaskID(spec.task_id)
+        with self._lock:
+            self._specs_inflight[spec.task_id] = spec
+            for i in range(spec.num_returns):
+                oid = ObjectID.from_index(task_id, i + 1)
+                fut = concurrent.futures.Future()
+                self._futures[oid.binary()] = fut
+                self._owned.add(oid.binary())
+                refs.append(ObjectRef(oid, self.addr))
+        for r in refs:
+            self.add_local_ref(r)
+        return refs
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        resources: Dict[str, float],
+        scheduling: Optional[SchedulingStrategy] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        lifetime: Optional[str] = None,
+        name: Optional[str] = None,
+        namespace: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> bytes:
+        import cloudpickle
+
+        actor_id = ActorID.of(JobID(self.job_id))
+        resources = dict(resources)
+        scheduling = scheduling or SchedulingStrategy()
+        if scheduling.kind == "PLACEMENT_GROUP":
+            resources = rewrite_resources_for_pg(
+                resources, scheduling.pg_id, scheduling.pg_bundle_index
+            )
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id).binary(),
+            job_id=self.job_id,
+            name=getattr(cls, "__name__", "Actor"),
+            func_blob=cloudpickle.dumps(cls),
+            method_name=None,
+            resources=resources,
+            scheduling=scheduling,
+            owner=self.addr,
+            actor_id=actor_id.binary(),
+            actor_creation=True,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            lifetime=lifetime,
+            name_registered=name,
+            namespace=namespace or self.namespace,
+            runtime_env=runtime_env,
+            caller_id=self.client_id.encode(),
+        )
+        if not pending:
+            spec.args = [self._finalize_slot(s) for s in enc_args]
+            spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+            reply = self.io.run(
+                self.gcs.request("register_actor", {"spec": spec}),
+                timeout=cfg.gcs_rpc_timeout_s,
+            )
+            if reply.get("error"):
+                raise ValueError(reply["error"])
+        else:
+            self.io.call_soon(
+                self._register_actor_when_ready(spec, enc_args, enc_kwargs, pending)
+            )
+        return actor_id.binary()
+
+    async def _register_actor_when_ready(self, spec, enc_args, enc_kwargs, pending):
+        for ref in pending:
+            try:
+                await asyncio.wait_for(
+                    asyncio.wrap_future(self.future_for(ref)),
+                    cfg.object_pull_timeout_s * 4,
+                )
+            except Exception:
+                logger.error("actor %s creation dependency failed", spec.name)
+        spec.args = [self._finalize_slot(s) for s in enc_args]
+        spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+        await self.gcs.request("register_actor", {"spec": spec})
+
+    def submit_actor_task(
+        self,
+        actor_id: bytes,
+        method_name: str,
+        args=(),
+        kwargs=None,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.for_actor_task(ActorID(actor_id))
+        with self._lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id,
+            name=method_name,
+            func_blob=None,
+            method_name=method_name,
+            num_returns=num_returns,
+            resources={},
+            owner=self.addr,
+            actor_id=actor_id,
+            max_retries=max_task_retries,
+            seq_no=seq,
+            caller_id=self.client_id.encode(),
+        )
+        refs = self._register_returns(spec)
+        self.io.call_soon(self._submit_when_ready(spec, enc_args, enc_kwargs, pending))
+        return refs
+
+    def get_actor_table(self, actor_id: Optional[bytes] = None,
+                        name: Optional[str] = None, namespace: Optional[str] = None):
+        return self.io.run(
+            self.gcs.request(
+                "get_actor",
+                {"actor_id": actor_id, "name": name,
+                 "namespace": namespace or self.namespace},
+            )
+        )
+
+    def wait_actor_alive(self, actor_id: bytes, timeout: float = 60.0):
+        return self.io.run(
+            self.gcs.request("wait_actor_alive",
+                             {"actor_id": actor_id, "timeout": timeout})
+        )
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self.io.run(
+            self.gcs.request("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
+        )
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        task_id = ref.id().task_id()
+        self.io.run(
+            self.raylet.request("cancel_task", {"task_id": task_id.binary(), "force": force})
+        )
+
+    # ------------------------------------------------------------------
+    # owner notifications (results arrive here)
+    # ------------------------------------------------------------------
+    async def rpc_task_result(self, conn: Connection, p):
+        task_id: bytes = p["task_id"]
+        with self._lock:
+            spec = self._specs_inflight.get(task_id)
+            if spec is not None and p.get("attempt", 0) < spec.attempt:
+                return  # stale notification from a superseded attempt
+        if p.get("error") is not None:
+            await self._handle_task_error(spec, task_id, p)
+            return
+        results = p["results"] or []
+        with self._lock:
+            self._specs_inflight.pop(task_id, None)
+        tid = TaskID(task_id)
+        for i, res in enumerate(results):
+            oid = ObjectID.from_index(tid, i + 1)
+            if res[0] == "v":
+                self._resolve_inline(oid.binary(), res[1], res[2])
+            else:
+                self._resolve_plasma(oid.binary())
+        if spec is not None:
+            self._release_submitted_refs(spec)
+        # Returns whose refs were already dropped can be freed now.
+        for i in range(len(results)):
+            self._maybe_free(ObjectID.from_index(tid, i + 1).binary())
+
+    def _release_submitted_refs(self, spec: TaskSpec):
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a[0] == "r":
+                with self._lock:
+                    n = self._submitted_refs.get(a[1], 0) - 1
+                    if n <= 0:
+                        self._submitted_refs.pop(a[1], None)
+                    else:
+                        self._submitted_refs[a[1]] = n
+                        continue
+                self._maybe_free(a[1])
+
+    async def _handle_task_error(self, spec: Optional[TaskSpec], task_id: bytes, p):
+        retriable = p.get("retriable", False)
+        app_error = p.get("app_error", False)
+        if spec is not None and retriable and spec.attempt < spec.max_retries and (
+            not app_error or spec.retry_exceptions
+        ):
+            spec.attempt += 1
+            logger.info("retrying task %s (attempt %d)", spec.name, spec.attempt)
+            await asyncio.sleep(cfg.task_retry_delay_ms / 1000.0)
+            try:
+                await self.raylet.request("submit_task", {"spec": spec})
+                return
+            except Exception:
+                pass
+        with self._lock:
+            self._specs_inflight.pop(task_id, None)
+        tid = TaskID(task_id)
+        n_returns = spec.num_returns if spec else 1
+        if p.get("error_value"):
+            meta, data = p["error_value"]
+        else:
+            if p.get("actor_dead"):
+                exc = ActorDiedError(p["error"])
+            elif p.get("cancelled"):
+                exc = TaskCancelledError(p["error"])
+            else:
+                exc = RuntimeError(p["error"])
+            sv = serialization.serialize_error(exc, spec.name if spec else "")
+            meta, data = sv.metadata, sv.to_bytes()
+        for i in range(n_returns):
+            oid = ObjectID.from_index(tid, i + 1)
+            self._resolve_inline(oid.binary(), meta, data)
+        if spec is not None:
+            self._release_submitted_refs(spec)
+
+    def _resolve_inline(self, oid: bytes, metadata: bytes, data: bytes):
+        with self._lock:
+            self._memory_store[oid] = (metadata, data)
+            fut = self._futures.get(oid)
+        if fut and not fut.done():
+            fut.set_result(("inline", metadata, data))
+
+    def _resolve_plasma(self, oid: bytes):
+        with self._lock:
+            fut = self._futures.get(oid)
+        if fut and not fut.done():
+            fut.set_result(("plasma", None, None))
+
+    # serving borrowers fetching owned values
+    async def rpc_fetch_owned(self, conn: Connection, p):
+        oid = p["object_id"]
+        with self._lock:
+            inline = self._memory_store.get(oid)
+            fut = self._futures.get(oid)
+        if inline is not None:
+            return {"inline": inline}
+        if fut is not None and fut.done():
+            return {"plasma": True}
+        if fut is not None:
+            return {"pending": True}
+        return {"unknown": True}
+
+    async def rpc_pubsub(self, conn: Connection, p):
+        for cb in self._pubsub_handlers.get(p["channel"], ()):
+            try:
+                cb(p["message"])
+            except Exception:
+                logger.exception("pubsub callback failed")
+
+    # delegated to the executor on worker processes
+    async def _await_executor(self):
+        while self.executor is None:
+            await asyncio.sleep(0.005)
+        return self.executor
+
+    async def rpc_execute_task(self, conn: Connection, p):
+        ex = await self._await_executor()
+        return await ex.execute_task(p["spec"])
+
+    async def rpc_become_actor(self, conn: Connection, p):
+        ex = await self._await_executor()
+        return await ex.become_actor(p["spec"])
+
+    def rpc_exit(self, conn: Connection, p):
+        logging.shutdown()
+        os._exit(0)
+
+    def subscribe(self, channel: str, callback):
+        self._pubsub_handlers.setdefault(channel, []).append(callback)
+        self.io.run(self.gcs.request("subscribe", {"channel": channel}))
+
+    def publish(self, channel: str, message):
+        self.io.run(self.gcs.request("publish", {"channel": channel, "message": message}))
+
+    # ------------------------------------------------------------------
+    # objects: put/get/wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        sv = serialization.serialize(value)
+        return self._put_serialized(sv)
+
+    def _put_serialized(self, sv: serialization.SerializedValue) -> ObjectRef:
+        if sv.nested_refs:
+            self.pin_escaped(sv.nested_refs)
+        with self._lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(self.task_id, idx)
+        if sv.total_data_len <= cfg.max_direct_call_object_size:
+            with self._lock:
+                self._memory_store[oid.binary()] = (sv.metadata, sv.to_bytes())
+                self._owned.add(oid.binary())
+        else:
+            object_store.write_object(
+                self.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
+            )
+            self.io.run(self.raylet.request("register_put", {"object_id": oid.binary()}))
+            with self._lock:
+                self._owned.add(oid.binary())
+        ref = ObjectRef(oid, self.addr)
+        self.add_local_ref(ref)
+        return ref
+
+    def future_for(self, ref: ObjectRef) -> concurrent.futures.Future:
+        with self._lock:
+            fut = self._futures.get(ref.binary())
+            if fut is not None:
+                return fut
+            if ref.binary() in self._memory_store:
+                fut = concurrent.futures.Future()
+                fut.set_result(("inline",) + self._memory_store[ref.binary()])
+                self._futures[ref.binary()] = fut
+                return fut
+            fut = concurrent.futures.Future()
+            self._futures[ref.binary()] = fut
+        if object_store.object_exists(self.store_dir, ref.id()):
+            if not fut.done():
+                fut.set_result(("plasma", None, None))
+            return fut
+        # Borrowed ref: resolve in background (plasma pull or owner fetch).
+        self.io.call_soon(self._resolve_borrowed(ref, fut))
+        return fut
+
+    async def _resolve_borrowed(self, ref: ObjectRef, fut: concurrent.futures.Future):
+        oid = ref.binary()
+        deadline = time.monotonic() + cfg.object_pull_timeout_s
+        while time.monotonic() < deadline and not fut.done():
+            if object_store.object_exists(self.store_dir, ref.id()):
+                if not fut.done():
+                    fut.set_result(("plasma", None, None))
+                return
+            owner = ref.owner
+            if owner is not None and tuple(owner) != self.addr:
+                try:
+                    r = await self.raylet.request(
+                        "fetch_owned_routed", {"owner": tuple(owner), "object_id": oid},
+                        timeout=10.0,
+                    )
+                except Exception:
+                    r = {}
+                if r.get("inline"):
+                    meta, data = r["inline"]
+                    self._resolve_inline(oid, meta, data)
+                    return
+                if r.get("plasma"):
+                    ok = (await self.raylet.request("pull_object", {"object_id": oid}))["ok"]
+                    if ok and not fut.done():
+                        fut.set_result(("plasma", None, None))
+                        return
+                if r.get("pending"):
+                    # Producer still running: keep waiting past the deadline.
+                    deadline = time.monotonic() + cfg.object_pull_timeout_s
+            else:
+                try:
+                    ok = (await self.raylet.request("pull_object", {"object_id": oid}))["ok"]
+                    if ok and not fut.done():
+                        fut.set_result(("plasma", None, None))
+                        return
+                except Exception:
+                    pass
+            await asyncio.sleep(0.05)
+        if not fut.done():
+            fut.set_exception(GetTimeoutError(f"could not resolve {ref}"))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        futs = [self.future_for(r) for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = []
+        for r, f in zip(refs, futs):
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                kind, meta, data = f.result(remaining)
+            except concurrent.futures.TimeoutError:
+                raise GetTimeoutError(
+                    f"Get timed out: {r} not ready after {timeout}s"
+                ) from None
+            values.append(self._materialize(r, kind, meta, data))
+        return values[0] if single else values
+
+    def _materialize(self, ref: ObjectRef, kind, meta, data):
+        if kind == "inline":
+            return serialization.deserialize(meta, data)
+        oid = ref.id()
+        buf = object_store.read_object(self.store_dir, oid)
+        if buf is None:
+            ok = self.io.run(self.raylet.request("pull_object", {"object_id": ref.binary()}))
+            if not ok.get("ok"):
+                raise GetTimeoutError(f"object {ref} lost and could not be re-fetched")
+            buf = object_store.read_object(self.store_dir, oid)
+            if buf is None:
+                raise GetTimeoutError(f"object {ref} unavailable")
+        with self._lock:
+            old = self._pinned_buffers.pop(ref.binary(), None)
+            self._pinned_buffers[ref.binary()] = buf
+        return serialization.deserialize(buf.metadata, buf.data)
+
+    def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
+             fetch_local=True):
+        futs = {self.future_for(r): r for r in refs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        done: set = set()
+        while len(done) < num_returns:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining < 0:
+                break
+            d, _ = concurrent.futures.wait(
+                [f for f in futs if f not in done], timeout=remaining,
+                return_when=concurrent.futures.FIRST_COMPLETED,
+            )
+            if not d:
+                break
+            done |= d
+        ready_set = {futs[f] for f in done}
+        ordered_ready = [r for r in refs if r in ready_set][:num_returns]
+        picked = set(ordered_ready)
+        not_ready = [r for r in refs if r not in picked]
+        return ordered_ready, not_ready
+
+    # ------------------------------------------------------------------
+    # reference counting (simplified; ray: reference_count.h:61)
+    # ------------------------------------------------------------------
+    def add_local_ref(self, ref: ObjectRef):
+        with self._lock:
+            self._local_refs[ref.binary()] = self._local_refs.get(ref.binary(), 0) + 1
+        ref._counted = True  # __del__ releases this count
+
+    def remove_local_ref(self, ref_binary: bytes):
+        with self._lock:
+            n = self._local_refs.get(ref_binary, 0) - 1
+            if n <= 0:
+                self._local_refs.pop(ref_binary, None)
+            else:
+                self._local_refs[ref_binary] = n
+                return
+        self._maybe_free(ref_binary)
+
+    def register_borrowed_ref(self, ref: ObjectRef):
+        with self._lock:
+            self._borrowed.add(ref.binary())
+
+    def pin_escaped(self, nested_refs):
+        """Pin owned objects whose refs are leaving this process."""
+        with self._lock:
+            for binary, _owner in nested_refs:
+                if binary in self._owned:
+                    self._escaped.add(binary)
+
+    def _maybe_free(self, oid: bytes):
+        with self._lock:
+            if oid not in self._owned or oid in self._escaped:
+                return
+            if self._local_refs.get(oid) or self._submitted_refs.get(oid):
+                return
+            if oid in self._specs_inflight:
+                return
+            self._owned.discard(oid)
+            self._memory_store.pop(oid, None)
+            self._futures.pop(oid, None)
+            buf = self._pinned_buffers.pop(oid, None)
+        if buf is not None:
+            try:
+                buf.release()
+            except Exception:
+                pass
+        try:
+            self.io.call_soon(self.raylet.request("free_object", {"object_id": oid}))
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def node_stats(self):
+        return self.io.run(self.raylet.request("node_stats", {}))
+
+    def get_nodes(self):
+        return self.io.run(self.gcs.request("get_nodes", {}))
+
+    def disconnect(self):
+        self.connected = False
+        try:
+            self.io.run(self.raylet.close(), timeout=2)
+            self.io.run(self.gcs.close(), timeout=2)
+        except Exception:
+            pass
+        self.io.stop()
+
+
+class Worker:
+    """Process-global holder (analog of ray: python/ray/_private/worker.py:410)."""
+
+    def __init__(self):
+        self.core_worker: Optional[CoreWorker] = None
+        self.node = None  # head Node if we started one
+        self.mode: Optional[str] = None
+
+    @property
+    def connected(self):
+        return self.core_worker is not None and self.core_worker.connected
+
+    def check_connected(self):
+        if not self.connected:
+            raise RuntimeError("ray_tpu.init() must be called before using the API")
+
+
+global_worker = Worker()

@@ -1,0 +1,39 @@
+"""Worker process entrypoint (analog of ray: python/ray/_private/workers/
+default_worker.py): connect the core worker to the local raylet + GCS, attach
+the task executor, and serve until told to exit."""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format=f"[worker pid={os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)
+    raylet_port = int(os.environ["RAY_TPU_RAYLET_PORT"])
+
+    from ray_tpu._private.executor import TaskExecutor
+    from ray_tpu._private.worker import CoreWorker, global_worker
+
+    cw = CoreWorker(
+        raylet_host="127.0.0.1",
+        raylet_port=raylet_port,
+        gcs_host=gcs_host,
+        gcs_port=int(gcs_port),
+        is_driver=False,
+    )
+    TaskExecutor(cw)
+    global_worker.core_worker = cw
+    global_worker.mode = "worker"
+    # Exit when our raylet goes away (the raylet owns worker lifetimes).
+    cw.raylet.on_close = lambda _conn: os._exit(0)
+    threading.Event().wait()  # serve forever; raylet kills us on shutdown
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,484 @@
+"""Public API: init/remote/get/put/wait + actors.
+
+Mirrors the reference's user-facing surface (ray: python/ray/_private/worker.py
+init:1108 get:2417 put:2546 wait:2609 remote:2952, remote_function.py:245,
+actor.py) on top of the TPU-native runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private.common import SchedulingStrategy
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    ActorDiedError,
+    CoreWorker,
+    GetTimeoutError,
+    TaskCancelledError,
+    global_worker,
+)
+from ray_tpu._private.serialization import TaskError
+
+logger = logging.getLogger(__name__)
+
+_init_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# init / shutdown
+# ---------------------------------------------------------------------------
+
+
+class RayContext:
+    def __init__(self, address: str, node_id: str):
+        self.address_info = {"address": address, "node_id": node_id}
+
+    def __getitem__(self, k):
+        return self.address_info[k]
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    namespace: Optional[str] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[dict] = None,
+    log_to_driver: bool = True,
+) -> RayContext:
+    """Start (or connect to) a cluster and connect this driver.
+
+    ray parity: ray.init (python/ray/_private/worker.py:1108). With no
+    address, starts a head node (GCS + raylet) owned by this process.
+    """
+    with _init_lock:
+        if global_worker.connected:
+            if ignore_reinit_error:
+                cw = global_worker.core_worker
+                return RayContext("existing", cw.node_id)
+            raise RuntimeError("ray_tpu.init() called twice")
+        if _system_config:
+            cfg.update(_system_config)
+        if object_store_memory:
+            cfg.update({"object_store_memory": object_store_memory})
+        if address is None:
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            from ray_tpu._private.node import NodeProcesses
+
+            node = NodeProcesses(head=True, resources=res or None, labels=labels)
+            global_worker.node = node
+            address = node.address
+            raylet_host, raylet_port = "127.0.0.1", node.raylet_port
+            gcs_host, gcs_port = address.rsplit(":", 1)
+        else:
+            gcs_host, gcs_port = address.rsplit(":", 1)
+            # Connecting to an existing cluster: find/start a local raylet is
+            # out of scope round 1 — connect to the head's raylet via GCS.
+            import asyncio
+
+            from ray_tpu._private.rpcio import EventLoopThread, connect as rpc_connect
+
+            tmp_io = EventLoopThread("init-probe")
+            conn = tmp_io.run(rpc_connect(gcs_host, int(gcs_port)))
+            nodes = tmp_io.run(conn.request("get_nodes", {}))
+            tmp_io.run(conn.close())
+            tmp_io.stop()
+            alive = [n for n in nodes if n["alive"]]
+            if not alive:
+                raise ConnectionError(f"no alive nodes in cluster at {address}")
+            raylet_host, raylet_port = alive[0]["host"], alive[0]["port"]
+        cw = CoreWorker(
+            raylet_host=raylet_host,
+            raylet_port=int(raylet_port),
+            gcs_host=gcs_host,
+            gcs_port=int(gcs_port),
+            is_driver=True,
+            namespace=namespace,
+        )
+        global_worker.core_worker = cw
+        global_worker.mode = "driver"
+        return RayContext(address, cw.node_id)
+
+
+def shutdown():
+    with _init_lock:
+        cw = global_worker.core_worker
+        if cw is not None:
+            try:
+                cw.disconnect()
+            except Exception:
+                pass
+            global_worker.core_worker = None
+        if global_worker.node is not None:
+            global_worker.node.shutdown()
+            global_worker.node = None
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+# ---------------------------------------------------------------------------
+# core object API
+# ---------------------------------------------------------------------------
+
+
+def put(value: Any) -> ObjectRef:
+    global_worker.check_connected()
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put on an ObjectRef is not allowed")
+    return global_worker.core_worker.put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    global_worker.check_connected()
+    if isinstance(refs, list):
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return global_worker.core_worker.get(refs, timeout=timeout)
+
+
+def wait(
+    refs: List[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    global_worker.check_connected()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(f"num_returns must be in 1..{len(refs)}")
+    return global_worker.core_worker.wait(
+        refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True):
+    global_worker.check_connected()
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    global_worker.core_worker.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    global_worker.check_connected()
+    global_worker.core_worker.cancel_task(ref, force=force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> "ActorHandle":
+    global_worker.check_connected()
+    table = global_worker.core_worker.get_actor_table(name=name, namespace=namespace)
+    if table is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(table["actor_id"], methods=None)
+
+
+# ---------------------------------------------------------------------------
+# options / resource translation
+# ---------------------------------------------------------------------------
+
+
+def _build_resources(opts: dict, default_cpu: float) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    elif "CPU" not in res:
+        res["CPU"] = default_cpu
+    if opts.get("num_gpus") is not None:
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("num_tpus") is not None:
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory") is not None:
+        res["memory"] = float(opts["memory"])
+    return {k: v for k, v in res.items() if v}
+
+
+def _build_scheduling(opts: dict) -> SchedulingStrategy:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None or strategy == "DEFAULT":
+        return SchedulingStrategy()
+    if strategy == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    # util.scheduling_strategies objects
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(
+            kind="NODE_AFFINITY", node_id=strategy.node_id, soft=strategy.soft
+        )
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            pg_id=pg.id_hex,
+            pg_bundle_index=(
+                None
+                if strategy.placement_group_bundle_index in (None, -1)
+                else strategy.placement_group_bundle_index
+            ),
+            pg_capture_child_tasks=strategy.placement_group_capture_child_tasks,
+        )
+    raise TypeError(f"unsupported scheduling_strategy: {strategy!r}")
+
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "memory", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "max_restarts", "max_task_retries",
+    "max_concurrency", "concurrency_groups", "name", "namespace", "lifetime",
+    "scheduling_strategy", "runtime_env", "max_calls", "get_if_exists",
+    "placement_group", "placement_group_bundle_index",
+}
+
+
+def _check_options(opts: dict):
+    for k in opts:
+        if k not in _VALID_OPTIONS:
+            raise ValueError(f"Invalid option keyword: {k!r}")
+
+
+# ---------------------------------------------------------------------------
+# RemoteFunction
+# ---------------------------------------------------------------------------
+
+
+class RemoteFunction:
+    """ray parity: python/ray/remote_function.py:245 (_remote)."""
+
+    def __init__(self, func, options: dict):
+        import cloudpickle
+
+        self._function = func
+        self._options = options
+        self._func_blob = cloudpickle.dumps(func)
+        self.__name__ = getattr(func, "__name__", "remote_function")
+        self.__doc__ = getattr(func, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use '{self.__name__}.remote()'."
+        )
+
+    def options(self, **opts):
+        _check_options(opts)
+        merged = {**self._options, **opts}
+        rf = RemoteFunction.__new__(RemoteFunction)
+        rf._function = self._function
+        rf._options = merged
+        rf._func_blob = self._func_blob
+        rf.__name__ = self.__name__
+        rf.__doc__ = self.__doc__
+        return rf
+
+    def remote(self, *args, **kwargs):
+        global_worker.check_connected()
+        opts = self._options
+        cw = global_worker.core_worker
+        num_returns = opts.get("num_returns", 1)
+        refs = cw.submit_task(
+            self._function,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=_build_resources(opts, default_cpu=1.0),
+            scheduling=_build_scheduling(opts),
+            max_retries=opts.get("max_retries", 3),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            name=self.__name__,
+            func_blob=self._func_blob,
+            runtime_env=opts.get("runtime_env"),
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Actors
+# ---------------------------------------------------------------------------
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts):
+        return ActorMethod(
+            self._handle, self._name, num_returns=opts.get("num_returns", self._num_returns)
+        )
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(
+            self._name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; "
+            f"use '.{self._name}.remote()'."
+        )
+
+
+class ActorHandle:
+    """ray parity: python/ray/actor.py ActorHandle."""
+
+    def __init__(self, actor_id: bytes, methods: Optional[dict] = None,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._methods = methods or {}
+        self._max_task_retries = max_task_retries
+
+    def _invoke(self, method_name, args, kwargs, num_returns=1):
+        global_worker.check_connected()
+        cw = global_worker.core_worker
+        refs = cw.submit_actor_task(
+            self._actor_id,
+            method_name,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            max_task_retries=self._max_task_retries,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, num_returns=self._methods.get(name, 1))
+
+    def __repr__(self):
+        return f"ActorHandle({ActorID(self._actor_id).hex()[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._methods, self._max_task_retries))
+
+    def _actor_id_hex(self):
+        return ActorID(self._actor_id).hex()
+
+
+class ActorClass:
+    """ray parity: python/ray/actor.py ActorClass (remote/options)."""
+
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = options
+        self.__name__ = cls.__name__
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            f"use '{self.__name__}.remote()'."
+        )
+
+    def options(self, **opts):
+        _check_options(opts)
+        return ActorClass(self._cls, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs):
+        global_worker.check_connected()
+        opts = self._options
+        cw = global_worker.core_worker
+        if opts.get("get_if_exists") and opts.get("name"):
+            table = cw.get_actor_table(name=opts["name"], namespace=opts.get("namespace"))
+            if table is not None:
+                return ActorHandle(table["actor_id"],
+                                   max_task_retries=opts.get("max_task_retries", 0))
+        # Collect @ray_tpu.method(num_returns=N) annotations for the handle.
+        method_returns = {
+            name: getattr(m, "__ray_num_returns__")
+            for name, m in vars(self._cls).items()
+            if callable(m) and hasattr(m, "__ray_num_returns__")
+        }
+        actor_id = cw.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            resources=_build_resources(opts, default_cpu=0.0),
+            scheduling=_build_scheduling(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            lifetime=opts.get("lifetime"),
+            name=opts.get("name"),
+            namespace=opts.get("namespace"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return ActorHandle(actor_id, methods=method_returns,
+                           max_task_retries=opts.get("max_task_retries", 0))
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# @remote decorator
+# ---------------------------------------------------------------------------
+
+
+def remote(*args, **kwargs):
+    """ray parity: ray.remote (python/ray/_private/worker.py:2952)."""
+
+    def decorate(target, opts):
+        import inspect
+
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        if callable(target):
+            return RemoteFunction(target, opts)
+        raise TypeError("@remote can only decorate functions or classes")
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return decorate(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword arguments only, e.g. @remote(num_cpus=2)")
+    _check_options(kwargs)
+    return lambda target: decorate(target, kwargs)
+
+
+def method(**opts):
+    """ray parity: ray.method — annotate num_returns on actor methods."""
+
+    def decorator(m):
+        m.__ray_num_returns__ = opts.get("num_returns", 1)
+        return m
+
+    return decorator

@@ -1,0 +1,107 @@
+"""Lazy task DAG API (ray parity: python/ray/dag/ — .bind()/.execute()).
+
+DAG nodes capture a remote callable plus bound args (which may themselves be
+nodes); ``execute`` walks the graph depth-first, submitting each node and
+threading ObjectRefs through as dependencies — the substrate for the Serve
+deployment-graph DSL and the workflow engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _resolve_inputs(self, cache):
+        args = [
+            a.execute(_cache=cache) if isinstance(a, DAGNode) else a
+            for a in self._bound_args
+        ]
+        kwargs = {
+            k: (v.execute(_cache=cache) if isinstance(v, DAGNode) else v)
+            for k, v in self._bound_kwargs.items()
+        }
+        return args, kwargs
+
+    def execute(self, *args, _cache=None):
+        cache = _cache if _cache is not None else {}
+        if args:
+            for node in self._collect_input_nodes():
+                node._value = args[0]
+        if id(self) in cache:
+            return cache[id(self)]
+        result = self._execute_impl(cache)
+        cache[id(self)] = result
+        return result
+
+    def _collect_input_nodes(self, seen=None):
+        seen = seen if seen is not None else set()
+        if id(self) in seen:
+            return []
+        seen.add(id(self))
+        found = [self] if isinstance(self, InputNode) else []
+        children = list(self._bound_args) + list(self._bound_kwargs.values())
+        if isinstance(self, ClassMethodNode) and isinstance(self._target, DAGNode):
+            children.append(self._target)
+        for child in children:
+            if isinstance(child, DAGNode):
+                found.extend(child._collect_input_nodes(seen))
+        return found
+
+    def _execute_impl(self, cache):
+        raise NotImplementedError
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _execute_impl(self, cache):
+        args, kwargs = self._resolve_inputs(cache)
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+
+    def _execute_impl(self, cache):
+        args, kwargs = self._resolve_inputs(cache)
+        return self._cls.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, handle_or_node, method_name, args, kwargs):
+        super().__init__(args, kwargs)
+        self._target = handle_or_node
+        self._method = method_name
+
+    def _execute_impl(self, cache):
+        target = self._target
+        if isinstance(target, DAGNode):
+            target = target.execute(_cache=cache)
+        args, kwargs = self._resolve_inputs(cache)
+        return getattr(target, self._method).remote(*args, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the DAG's runtime input (ray: dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__((), {})
+        self._value = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache):
+        return self._value

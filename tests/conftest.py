@@ -1,0 +1,46 @@
+"""Test fixtures (analog of ray: python/ray/tests/conftest.py).
+
+``ray_start_regular`` spins a real single-node cluster (GCS + raylet
+subprocesses) per test module; ``ray_start_cluster`` provides the multi-node
+Cluster fixture. JAX-using tests force an 8-device virtual CPU mesh so
+multi-chip sharding is exercised without TPU hardware.
+"""
+
+import os
+
+# Virtual 8-device CPU mesh for sharding tests; must be set before jax import.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, resources={"custom": 2.0})
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular_fn():
+    """Function-scoped variant for tests that mutate cluster state."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    yield cluster
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    cluster.shutdown()

@@ -1,0 +1,169 @@
+"""Core API tests (analog of ray: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(41)
+    assert ray_tpu.get(ref) == 41
+    arr = np.arange(1_000_000, dtype=np.float32)  # large -> plasma
+    ref2 = ray_tpu.put(arr)
+    out = ray_tpu.get(ref2)
+    np.testing.assert_array_equal(out, arr)
+    # zero-copy read: buffer should not be writable (mmap-backed view)
+    assert not out.flags.writeable
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, y)
+    assert ray_tpu.get(z) == 30
+
+
+def test_large_args_and_returns(ray_start_regular):
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    arr = np.ones((512, 1024), dtype=np.float32)  # 2MB -> plasma
+    ref = double.remote(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaput")
+
+    with pytest.raises(ray_tpu.TaskError) as exc_info:
+        ray_tpu.get(boom.remote())
+    assert "kaput" in str(exc_info.value)
+    assert isinstance(exc_info.value.cause, ValueError)
+
+
+def test_dependent_task_inherits_error(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("upstream")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    a, b = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([a, b], num_returns=1, timeout=4)
+    assert ready == [a]
+    assert not_ready == [b]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(4)) == 41
+
+
+def test_nested_object_refs(ray_start_regular):
+    @ray_tpu.remote
+    def make_refs():
+        import ray_tpu as rt
+
+        return [rt.put(1), rt.put(2)]
+
+    refs = ray_tpu.get(make_refs.remote())
+    assert ray_tpu.get(refs) == [1, 2]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.5)
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == "ok"
+
+
+def test_custom_resources(ray_start_regular):
+    @ray_tpu.remote(resources={"custom": 1})
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+
+
+def test_runtime_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.node_id
+    assert ctx.get_job_id()
+
+    @ray_tpu.remote
+    def get_task_id():
+        return ray_tpu.get_runtime_context().get_task_id()
+
+    assert ray_tpu.get(get_task_id.remote()) is not None
