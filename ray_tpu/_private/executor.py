@@ -102,7 +102,15 @@ class TaskExecutor:
                 await self._advance_turn(spec.caller_id)
 
     async def _await_turn(self, caller_id: bytes, seq_no: int):
-        q = self._caller_queues.setdefault(caller_id, _CallerQueue())
+        q = self._caller_queues.get(caller_id)
+        if q is None:
+            # First task from this caller: adopt its sequence number. After an
+            # actor restart the caller's counter keeps increasing, so the gate
+            # must re-anchor rather than wait for seq 0 (which already ran in
+            # the previous incarnation).
+            q = _CallerQueue()
+            q.next_seq = seq_no
+            self._caller_queues[caller_id] = q
         async with q.cond:
             await q.cond.wait_for(lambda: q.next_seq >= seq_no)
 

@@ -1,0 +1,154 @@
+"""Actor tests (analog of ray: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_basic(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote()) == 6
+    assert ray_tpu.get(c.incr.remote(10)) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_actor_init_error(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=30)
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Flaky:
+        def boom(self):
+            raise KeyError("nope")
+
+        def ok(self):
+            return 1
+
+    f = Flaky.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(f.boom.remote())
+    # actor survives method errors
+    assert ray_tpu.get(f.ok.remote()) == 1
+
+
+def test_named_actor_namespace(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            return "A"
+
+    A.options(name="shared", namespace="ns1").remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("shared", namespace="ns2")
+    h = ray_tpu.get_actor("shared", namespace="ns1")
+    assert ray_tpu.get(h.who.remote()) == "A"
+
+
+def test_get_if_exists(ray_start_regular):
+    @ray_tpu.remote
+    class Singleton:
+        def __init__(self):
+            self.t = time.time()
+
+        def created(self):
+            return self.t
+
+    s1 = Singleton.options(name="singleton", get_if_exists=True).remote()
+    t1 = ray_tpu.get(s1.created.remote())
+    s2 = Singleton.options(name="singleton", get_if_exists=True).remote()
+    t2 = ray_tpu.get(s2.created.remote())
+    assert t1 == t2
+
+
+def test_actor_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def block(self, t):
+            time.sleep(t)
+            return "done"
+
+    s = Slow.remote()
+    ray_tpu.get(s.block.remote(0.01), timeout=60)  # wait for actor to be up
+    t0 = time.time()
+    refs = [s.block.remote(1.0) for _ in range(4)]
+    ray_tpu.get(refs, timeout=60)
+    elapsed = time.time() - t0
+    assert elapsed < 3.0, f"calls did not overlap: {elapsed}"
+
+
+def test_async_actor(ray_start_regular):
+    import asyncio
+
+    @ray_tpu.remote(max_concurrency=8)
+    class AsyncActor:
+        async def work(self, t):
+            await asyncio.sleep(t)
+            return "async-done"
+
+    a = AsyncActor.remote()
+    ray_tpu.get(a.work.remote(0.01), timeout=60)  # wait for actor to be up
+    t0 = time.time()
+    refs = [a.work.remote(1.0) for _ in range(5)]
+    assert ray_tpu.get(refs, timeout=60) == ["async-done"] * 5
+    assert time.time() - t0 < 4.5  # serial execution would take >= 5s
+
+
+def test_actor_handle_pass(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray_tpu.remote
+    def writer(store, k, v):
+        return ray_tpu.get(store.set.remote(k, v))
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, "x", 42), timeout=60)
+    assert ray_tpu.get(s.get.remote("x")) == 42
+
+

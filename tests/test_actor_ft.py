@@ -1,0 +1,42 @@
+"""Actor fault-tolerance tests (restart, kill) — fresh cluster per test."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_restart(ray_start_regular_fn):
+    @ray_tpu.remote(max_restarts=1)
+    class Dying:
+        def __init__(self):
+            self.pid = os.getpid()
+
+        def get_pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    d = Dying.remote()
+    pid1 = ray_tpu.get(d.get_pid.remote(), timeout=30)
+    d.die.remote()
+    time.sleep(2)
+    pid2 = ray_tpu.get(d.get_pid.remote(), timeout=60)
+    assert pid2 != pid1  # restarted in a fresh process
+
+
+def test_kill_actor(ray_start_regular_fn):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(1)
+    with pytest.raises(Exception):
+        ray_tpu.get(v.ping.remote(), timeout=15)
