@@ -46,10 +46,22 @@ from ray_tpu._private.rpcio import Connection, RpcServer, connect
 logger = logging.getLogger(__name__)
 
 
+def runtime_env_hash(runtime_env: Optional[dict]) -> str:
+    """Stable hash of a runtime env; workers are pooled per hash
+    (ray: worker_pool.h keyed by runtime-env hash)."""
+    if not runtime_env:
+        return ""
+    import json
+
+    return json.dumps(runtime_env, sort_keys=True)
+
+
 class _Worker:
-    def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes]):
+    def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes],
+                 env_hash: str = ""):
         self.proc = proc
         self.job_id = job_id
+        self.env_hash = env_hash
         self.conn: Optional[Connection] = None
         self.client_id: Optional[str] = None
         self.busy_with: Optional[bytes] = None  # task_id
@@ -94,8 +106,8 @@ class Raylet:
         self.peers: Dict[str, Connection] = {}
         # Client registry: client_id -> Connection (drivers + workers on node)
         self.clients: Dict[str, Connection] = {}
-        # Worker pool
-        self.idle_workers: deque = deque()
+        # Worker pool (idle queues keyed by runtime-env hash)
+        self.idle_workers: Dict[str, deque] = {}
         self.all_workers: Dict[int, _Worker] = {}  # pid -> worker
         self.workers_by_client: Dict[str, _Worker] = {}
         self.local_actors: Dict[bytes, _Worker] = {}
@@ -240,10 +252,12 @@ class Raylet:
         if w is None:
             return
         self.all_workers.pop(w.proc.pid, None)
-        try:
-            self.idle_workers.remove(w)
-        except ValueError:
-            pass
+        pool = self.idle_workers.get(w.env_hash)
+        if pool is not None:
+            try:
+                pool.remove(w)
+            except ValueError:
+                pass
         if w.actor_id is not None:
             self.local_actors.pop(w.actor_id, None)
             try:
@@ -396,7 +410,7 @@ class Raylet:
             self._dispatch_event.set()
             return
         if w.actor_id is None and not w.conn.closed:
-            self.idle_workers.append(w)
+            self._return_worker(w)
         await self._deliver_result(qt.spec, result)
         self._dispatch_event.set()
 
@@ -460,20 +474,37 @@ class Raylet:
     # ------------------------------------------------------------------
     # worker pool
     # ------------------------------------------------------------------
+    def _return_worker(self, w: _Worker):
+        self.idle_workers.setdefault(w.env_hash, deque()).append(w)
+
     async def _pop_worker(self, spec: TaskSpec) -> Optional[_Worker]:
-        while self.idle_workers:
-            w = self.idle_workers.popleft()
+        env_hash = runtime_env_hash(spec.runtime_env)
+        pool = self.idle_workers.get(env_hash)
+        while pool:
+            w = pool.popleft()
             if w.conn is not None and not w.conn.closed:
                 return w
         n_alive = len(self.all_workers)
         if n_alive >= cfg.num_workers_soft_limit:
+            # Reclaim an idle worker of a different runtime env.
+            for other in self.idle_workers.values():
+                while other:
+                    victim = other.popleft()
+                    if victim.conn is not None and not victim.conn.closed:
+                        victim.kill_intended = True
+                        victim.proc.terminate()
+                        break
             return None
-        return await self._start_worker(spec.job_id)
+        return await self._start_worker(spec.job_id, spec.runtime_env)
 
-    async def _start_worker(self, job_id: Optional[bytes]) -> Optional[_Worker]:
+    async def _start_worker(self, job_id: Optional[bytes],
+                            runtime_env: Optional[dict] = None) -> Optional[_Worker]:
         from ray_tpu._private.node import package_env
 
         env = package_env()
+        if runtime_env:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = str(v)
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_RAYLET_PORT"] = str(self.port)
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
@@ -520,7 +551,7 @@ class Raylet:
             return {"rejected": True, "detail": str(e)}
         if reply.get("error"):
             res_add(self.resources_available, spec.resources)
-            self.idle_workers.append(w)
+            self._return_worker(w)
             return {"error": reply["error"]}
         w.actor_id = spec.actor_id
         w.actor_resources = dict(spec.resources)
@@ -798,7 +829,7 @@ class Raylet:
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "num_workers": len(self.all_workers),
-            "num_idle_workers": len(self.idle_workers),
+            "num_idle_workers": sum(len(q) for q in self.idle_workers.values()),
             "queued": len(self.ready) + len(self.waiting),
             "running": len(self.running),
             "store_used_bytes": self.store.used_bytes(),

@@ -379,7 +379,11 @@ class ActorHandle:
         return refs
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # Dunders and the handle's own slots must miss normally (pickle
+        # probes these); anything else resolves to a remote method proxy.
+        if name.startswith("__") or name in (
+            "_actor_id", "_methods", "_max_task_retries"
+        ):
             raise AttributeError(name)
         return ActorMethod(self, name, num_returns=self._methods.get(name, 1))
 

@@ -1,0 +1,33 @@
+from ray_tpu.parallel.collectives import (
+    all_gather,
+    compiled_allreduce,
+    pmean,
+    ppermute_next,
+    psum,
+    reduce_scatter,
+)
+from ray_tpu.parallel.mesh_utils import (
+    auto_mesh,
+    create_mesh,
+    data_sharding,
+    logical_to_physical,
+    mesh_from_cluster,
+    replicated,
+    shard_params_fsdp,
+)
+
+__all__ = [
+    "all_gather",
+    "auto_mesh",
+    "compiled_allreduce",
+    "create_mesh",
+    "data_sharding",
+    "logical_to_physical",
+    "mesh_from_cluster",
+    "pmean",
+    "ppermute_next",
+    "psum",
+    "reduce_scatter",
+    "replicated",
+    "shard_params_fsdp",
+]

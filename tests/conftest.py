@@ -9,8 +9,19 @@ multi-chip sharding is exercised without TPU hardware.
 import os
 
 # Virtual 8-device CPU mesh for sharding tests; must be set before jax import.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the ambient env pins JAX_PLATFORMS to the real TPU tunnel,
+# but tests must never grab the chip (bench.py runs outside pytest and does).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already frozen into jax.config — override it before any
+# backend initialization so tests use the virtual CPU devices.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
