@@ -1,0 +1,65 @@
+"""Benchmark: GPT-2-124M training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md / BASELINE.json): the north-star target is >=90%
+of per-chip GPT-2-124M throughput of torch-DDP on A100. An A100 at the
+commonly reported ~38-40% MFU for this model does ~0.9 GFLOP/token effective
+-> ~130k tokens/s/chip; the 90% bar is therefore ~117k tokens/s/chip.
+vs_baseline = measured / 117_000 (>=1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    # Sized for one v5e chip (16GB HBM): bf16 compute, f32 params.
+    if on_tpu:
+        batch_size, seq_len, steps, warmup = 8, 1024, 10, 3
+        config = gpt2.GPT2Config.gpt2_124m()
+    else:  # CPU smoke fallback so the bench always emits a line
+        batch_size, seq_len, steps, warmup = 2, 128, 3, 1
+        config = gpt2.GPT2Config.small_test()
+
+    model, params, tx, opt_state = gpt2.make_train_state(
+        config, jax.random.PRNGKey(0)
+    )
+    step = gpt2.build_train_step(model, tx, donate=True)
+    batch = gpt2.synthetic_batch(
+        jax.random.PRNGKey(1), batch_size, seq_len, config.vocab_size
+    )
+    batch = {k: jax.device_put(v) for k, v in batch.items()}
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)  # hard sync: device_get round-trip (block_until_ready is not
+    # a reliable fence through relayed/experimental PJRT backends)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch_size * seq_len * steps / dt
+    baseline = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -486,14 +486,18 @@ class Raylet:
                 return w
         n_alive = len(self.all_workers)
         if n_alive >= cfg.num_workers_soft_limit:
-            # Reclaim an idle worker of a different runtime env.
+            # Reclaim ONE idle worker of a different runtime env to free a slot.
             for other in self.idle_workers.values():
+                reclaimed = False
                 while other:
                     victim = other.popleft()
                     if victim.conn is not None and not victim.conn.closed:
                         victim.kill_intended = True
                         victim.proc.terminate()
+                        reclaimed = True
                         break
+                if reclaimed:
+                    break
             return None
         return await self._start_worker(spec.job_id, spec.runtime_env)
 
@@ -521,7 +525,7 @@ class Raylet:
             stdout=open(os.path.join(log_path, f"worker-{time.time():.0f}-{os.getpid()}.out"), "ab"),
             stderr=subprocess.STDOUT,
         )
-        w = _Worker(proc, job_id)
+        w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env))
         self.all_workers[proc.pid] = w
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)

@@ -1,0 +1,62 @@
+"""AIR configs (ray parity: python/ray/air/config.py:93,526,577,707).
+
+ScalingConfig's TPU delta: the unit of a "worker" is a HOST owning all its
+local chips (libtpu single-client constraint, SURVEY §7) — so
+``use_tpu + chips_per_worker`` replaces the reference's one-GPU-per-worker
+model, and ``topology`` requests a specific slice shape for gang scheduling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for API parity; mapped to nothing on TPU
+    chips_per_worker: Optional[int] = None  # TPU chips each host-worker owns
+    topology: Optional[str] = None  # e.g. "v5e-8": slice request label
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.chips_per_worker or 1)
+        return res
+
+    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False
+    callbacks: Optional[list] = None

@@ -379,11 +379,11 @@ class ActorHandle:
         return refs
 
     def __getattr__(self, name):
-        # Dunders and the handle's own slots must miss normally (pickle
-        # probes these); anything else resolves to a remote method proxy.
-        if name.startswith("__") or name in (
-            "_actor_id", "_methods", "_max_task_retries"
-        ):
+        # Underscore attributes must miss normally (pickle/IPython probe
+        # private hooks like _repr_html_, and duck-typed hasattr checks rely
+        # on AttributeError). Exception: the "_rt_" prefix is this framework's
+        # convention for internal remote methods (e.g. _rt_init_collective).
+        if name.startswith("_") and not name.startswith("_rt_"):
             raise AttributeError(name)
         return ActorMethod(self, name, num_returns=self._methods.get(name, 1))
 

@@ -1,0 +1,195 @@
+"""GPT-2 in Flax — the flagship benchmark model (124M config).
+
+The reference benches Ray Train with torch GPT-2 DDP
+(ray: release/air_tests/air_benchmarks/ + driver BASELINE config
+"GPT-2-124M data-parallel"). TPU-native: params in f32, compute in bf16 so
+matmuls hit the MXU; batch sharded over the data/fsdp mesh axes; gradient
+reduction is inserted by the XLA partitioner from the sharding annotations
+(no hand-written allreduce); optional remat trades FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @classmethod
+    def gpt2_124m(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def small_test(cls, **kw):
+        base = dict(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+        base.update(kw)
+        return cls(**base)
+
+    def num_params(self) -> int:
+        wpe = self.n_positions * self.n_embd
+        wte = self.vocab_size * self.n_embd
+        block = 12 * self.n_embd * self.n_embd + 13 * self.n_embd
+        return wte + wpe + self.n_layer * block + 2 * self.n_embd
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        c = self.config
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * C, dtype=c.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        heads = c.n_head
+        q = q.reshape(B, T, heads, C // heads)
+        k = k.reshape(B, T, heads, C // heads)
+        v = v.reshape(B, T, heads, C // heads)
+        # jax.nn.dot_product_attention lowers to fused (splash/flash)
+        # attention on TPU backends.
+        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        y = y.reshape(B, T, C)
+        return nn.Dense(C, dtype=c.dtype, name="c_proj")(y)
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        c = self.config
+        h = nn.Dense(4 * c.n_embd, dtype=c.dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(c.n_embd, dtype=c.dtype, name="c_proj")(h)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        c = self.config
+        x = x + CausalSelfAttention(c, name="attn")(
+            nn.LayerNorm(dtype=c.dtype, name="ln_1")(x), deterministic
+        )
+        x = x + MLP(c, name="mlp")(
+            nn.LayerNorm(dtype=c.dtype, name="ln_2")(x), deterministic
+        )
+        return x
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        c = self.config
+        B, T = input_ids.shape
+        wte = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte")
+        wpe = nn.Embed(c.n_positions, c.n_embd, dtype=c.dtype, name="wpe")
+        x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
+        block = Block
+        if c.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(c.n_layer):
+            x = block(c, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        # weight-tied LM head
+        logits = wte.attend(x.astype(jnp.float32))
+        return logits
+
+
+def loss_fn(params, model, batch):
+    logits = model.apply({"params": params}, batch["input_ids"])
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        return -ll.mean()
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
+                     weight_decay: float = 0.1):
+    model = GPT2(config)
+    dummy = jnp.zeros((1, min(8, config.n_positions)), dtype=jnp.int32)
+    params = model.init(rng, dummy)["params"]
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    opt_state = tx.init(params)
+    return model, params, tx, opt_state
+
+
+def build_train_step(model, tx, donate: bool = True):
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+    Sharding is inferred from the placed arguments (use
+    ``shard_train_state`` / ``shard_batch`` first): with batch sharded over
+    data axes and params replicated (DP) or fsdp-sharded (ZeRO-3), the XLA
+    partitioner inserts the gradient psum / reduce-scatter on ICI — the
+    TPU-native replacement for the reference's NCCL-DDP allreduce.
+    """
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def shard_train_state(params, opt_state, mesh: Mesh, fsdp: bool = False):
+    """Place params + optimizer state on the mesh (DP replicate or FSDP
+    shard); optimizer moments inherit their parameter's sharding."""
+    from ray_tpu.parallel.mesh_utils import replicated, shard_params_fsdp
+
+    if fsdp:
+        p_sh = shard_params_fsdp(params, mesh)
+    else:
+        p_sh = jax.tree.map(lambda _: replicated(mesh), params)
+    params = jax.tree.map(jax.device_put, params, p_sh)
+    p_treedef = jax.tree_util.tree_structure(params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == p_treedef
+        except Exception:
+            return False
+
+    def place(node):
+        if is_params_like(node):
+            return jax.tree.map(jax.device_put, node, p_sh)
+        return jax.tree.map(lambda l: jax.device_put(l, replicated(mesh)), node)
+
+    opt_state = jax.tree.map(place, opt_state, is_leaf=is_params_like)
+    return params, opt_state
+
+
+def shard_batch(batch, mesh: Mesh):
+    from ray_tpu.parallel.mesh_utils import data_sharding
+
+    sh = data_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def synthetic_batch(rng, batch_size: int, seq_len: int, vocab: int):
+    ids = jax.random.randint(rng, (batch_size, seq_len + 1), 0, vocab, dtype=jnp.int32)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}

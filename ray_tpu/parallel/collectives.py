@@ -9,7 +9,6 @@ out-of-graph API (ray_tpu.util.collective) is for orchestration-sized data.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -61,8 +60,3 @@ def compiled_allreduce(mesh: Mesh, axis: str = "data", dtype=jnp.float32):
         in_shardings=NamedSharding(mesh, in_spec),
         out_shardings=NamedSharding(mesh, out_spec),
     )
-
-
-@functools.partial(jax.jit, static_argnames=("axis",))
-def _noop(x, axis=None):
-    return x

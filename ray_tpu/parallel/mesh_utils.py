@@ -80,8 +80,11 @@ def auto_mesh(
 
 
 def data_sharding(mesh: Mesh, *data_axes: str) -> NamedSharding:
-    """Sharding for a batch: leading dim split over data-like axes."""
+    """Sharding for a batch: leading dim split over data-like axes; replicated
+    if the mesh has no data-like axis."""
     axes = data_axes or tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    if not axes:
+        return NamedSharding(mesh, PartitionSpec())
     return NamedSharding(mesh, PartitionSpec(axes if len(axes) > 1 else axes[0]))
 
 

@@ -1,0 +1,41 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig, TorchConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    TorchTrainer,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BackendExecutor",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TorchConfig",
+    "TorchTrainer",
+    "TrainingFailedError",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "report",
+]

@@ -1,0 +1,185 @@
+"""Backend plugin interface + JAX and Torch backends.
+
+ray parity: python/ray/train/backend.py:41,53 (Backend/BackendConfig) and the
+framework configs (torch/config.py:29 TorchConfig + :69
+_setup_torch_process_group, tensorflow/config.py TF_CONFIG). The TPU-native
+backend is JaxConfig: instead of a NCCL process group, workers form a JAX
+distributed system — one worker process per host owning all local chips,
+``jax.distributed.initialize`` keyed by the worker group, collectives riding
+ICI inside jitted steps.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config):
+        pass
+
+    def on_training_start(self, worker_group, backend_config):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# JAX backend (the TPU path)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Per-worker JAX setup.
+
+    distributed: "auto" initializes jax.distributed only for multi-worker
+    TPU gangs (multi-host pods); "off" leaves workers as independent JAX
+    processes whose host-level sync goes through ray_tpu.util.collective;
+    "force" always initializes.
+    """
+
+    distributed: str = "auto"
+    use_tpu: bool = False
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _jax_worker_setup(coordinator: Optional[str], num_processes: int,
+                      process_id: int, env_vars: Dict[str, str]):
+    for k, v in env_vars.items():
+        os.environ[k] = str(v)
+    if coordinator is not None:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return True
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_host() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, config: JaxConfig):
+        n = worker_group.num_workers
+        coordinator = None
+        if config.distributed == "force" or (
+            config.distributed == "auto" and config.use_tpu and n > 1
+        ):
+            host = worker_group.execute_single(0, _get_host)
+            coordinator = f"{host}:{_free_port()}"
+        import ray_tpu
+
+        refs = []
+        for i, w in enumerate(worker_group.workers):
+            refs.append(
+                w.execute.remote(
+                    _jax_worker_setup, coordinator, n, i, dict(config.env_vars)
+                )
+            )
+        ray_tpu.get(refs, timeout=300)
+        # Host-level collective group for out-of-graph sync (weight
+        # broadcast, metric reduction) — the Gloo-analog path.
+        if n > 1:
+            from ray_tpu.util import collective as col
+
+            col.create_collective_group(
+                worker_group.workers, n, list(range(n)),
+                backend="store", group_name="train_dp",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Torch backend (CPU gloo — API parity for reference workloads)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_method: str = "tcp"
+    timeout_s: int = 1800
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _torch_worker_setup(master_addr: str, master_port: int, rank: int,
+                        world_size: int, backend: str, timeout_s: int):
+    """ray parity: train/torch/config.py:69 _setup_torch_process_group."""
+    import datetime
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return True
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master_addr}:{master_port}",
+        rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s),
+    )
+    return True
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, config: TorchConfig):
+        import ray_tpu
+
+        master_addr = "127.0.0.1"
+        master_port = _free_port()
+        refs = []
+        for i, w in enumerate(worker_group.workers):
+            refs.append(
+                w.execute.remote(
+                    _torch_worker_setup, master_addr, master_port, i,
+                    worker_group.num_workers, config.backend, config.timeout_s,
+                )
+            )
+        ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group, config):
+        def _destroy():
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+
+        try:
+            worker_group.execute(_destroy)
+        except Exception:
+            pass

@@ -1,0 +1,119 @@
+"""Train session: worker↔driver report plumbing.
+
+ray parity: python/ray/train/_internal/session.py:84 (_TrainSession),
+air/session.py (report/get_checkpoint/get_context). Inside a train worker the
+user loop calls ``report(metrics, checkpoint=...)``; results flow through a
+queue polled by the BackendExecutor on the driver.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, local_rank: int = 0,
+                 local_world_size: int = 1, node_rank: int = 0,
+                 experiment_name: str = "", trial_name: str = "",
+                 trial_id: str = "", trial_dir: str = ""):
+        self._rank = rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self._local_world_size = local_world_size
+        self._node_rank = node_rank
+        self._experiment_name = experiment_name
+        self._trial_name = trial_name
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_trial_name(self) -> str:
+        return self._trial_name
+
+    def get_trial_id(self) -> str:
+        return self._trial_id
+
+    def get_trial_dir(self) -> str:
+        return self._trial_dir
+
+
+class _Session:
+    def __init__(self, ctx: TrainContext, loaded_checkpoint: Optional[Checkpoint]):
+        self.ctx = ctx
+        self.queue: "queue.Queue" = queue.Queue()
+        self.loaded_checkpoint = loaded_checkpoint
+        self.stop_requested = threading.Event()
+
+
+_session: Optional[_Session] = None
+_lock = threading.Lock()
+
+
+def init_session(ctx: TrainContext, loaded_checkpoint: Optional[Checkpoint]) -> _Session:
+    global _session
+    with _lock:
+        _session = _Session(ctx, loaded_checkpoint)
+    return _session
+
+
+def shutdown_session():
+    global _session
+    with _lock:
+        _session = None
+
+
+def get_session() -> Optional[_Session]:
+    return _session
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+    """ray parity: ray.train.report — ship metrics (+ checkpoint) to the
+    driver. Outside a session, a no-op with the metrics returned for
+    testability."""
+    s = _session
+    if s is None:
+        return metrics
+    payload = {"type": "report", "metrics": dict(metrics)}
+    if checkpoint is not None:
+        # Materialize to a directory so the driver (possibly another node)
+        # persists it from shared storage; in-memory dicts ride the queue.
+        payload["checkpoint_data"] = (
+            checkpoint._data if checkpoint._data is not None else None
+        )
+        payload["checkpoint_path"] = checkpoint._path
+    s.queue.put(payload)
+    if s.stop_requested.is_set():
+        raise SystemExit("training stop requested")
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _session
+    return s.loaded_checkpoint if s else None
+
+
+def get_context() -> TrainContext:
+    s = _session
+    if s is None:
+        return TrainContext(rank=0, world_size=1)
+    return s.ctx

@@ -1,0 +1,133 @@
+"""Train worker gang.
+
+ray parity: python/ray/train/_internal/worker_group.py:100 (WorkerGroup of
+RayTrainWorker actors) — a gang of actors, one per host-worker, created
+inside a placement group, each running the user train loop on a session
+thread and draining a result queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train import session as session_mod
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """ray parity: worker_group.py:18 RayTrainWorker."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+        self._final: Optional[dict] = None
+
+    def setup_session(self, rank: int, world_size: int, local_rank: int,
+                      node_rank: int, experiment_name: str, trial_id: str,
+                      trial_dir: str, checkpoint: Optional[Checkpoint]):
+        ctx = session_mod.TrainContext(
+            rank=rank, world_size=world_size, local_rank=local_rank,
+            node_rank=node_rank, experiment_name=experiment_name,
+            trial_id=trial_id, trial_dir=trial_dir,
+        )
+        self._session = session_mod.init_session(ctx, checkpoint)
+        return True
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary callable on the worker (backend setup hooks)."""
+        return fn(*args, **kwargs)
+
+    def _rt_init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
+    def start_training(self, train_fn: Callable, config: dict):
+        assert self._session is not None, "setup_session must run first"
+        sess = self._session
+
+        def _run():
+            try:
+                import inspect
+
+                sig = inspect.signature(train_fn)
+                if len(sig.parameters) >= 1:
+                    train_fn(config)
+                else:
+                    train_fn()
+                sess.queue.put({"type": "done"})
+            except SystemExit:
+                sess.queue.put({"type": "done"})
+            except BaseException as e:  # noqa: BLE001
+                sess.queue.put({
+                    "type": "error",
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc(),
+                })
+
+        self._thread = threading.Thread(target=_run, name="train-loop", daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout: float = 300.0):
+        """Block for the next report/done/error from the train loop."""
+        import queue as _q
+
+        try:
+            return self._session.queue.get(timeout=timeout)
+        except _q.Empty:
+            return {"type": "timeout"}
+
+    def request_stop(self):
+        if self._session:
+            self._session.stop_requested.set()
+        return True
+
+    def shutdown_session(self):
+        session_mod.shutdown_session()
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict,
+                 placement_group=None, runtime_env: Optional[dict] = None):
+        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        self.num_workers = num_workers
+        self.workers: List = []
+        for i in range(num_workers):
+            opts = dict(resources=dict(resources_per_worker), num_cpus=0)
+            if placement_group is not None:
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group, placement_group_bundle_index=i
+                )
+            if runtime_env:
+                opts["runtime_env"] = runtime_env
+            self.workers.append(TrainWorker.options(**opts).remote())
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(
+            [w.execute.remote(fn, *args, **kwargs) for w in self.workers],
+            timeout=600,
+        )
+
+    def execute_single(self, index: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(
+            self.workers[index].execute.remote(fn, *args, **kwargs), timeout=600
+        )
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -57,6 +57,12 @@ class _Group:
     rank: int
     backend: str
     seq: int = 0
+    p2p_send: Dict[int, int] = None  # per-destination send counters
+    p2p_recv: Dict[int, int] = None  # per-source recv counters
+
+    def __post_init__(self):
+        self.p2p_send = {}
+        self.p2p_recv = {}
 
 
 _groups: Dict[str, _Group] = {}
@@ -208,10 +214,10 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
     outs = _phase(g, "ar", timeout, pickle.dumps(arr, protocol=5))
     stacked = [pickle.loads(o) for o in outs]
     result = _REDUCERS[op](np.stack(stacked))
-    if isinstance(tensor, np.ndarray):
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result.astype(tensor.dtype, copy=False))
         return tensor
-    return result
+    return result.astype(arr.dtype, copy=False)
 
 
 def allreduce_multigpu(tensor_list, group_name: str = "default", op=ReduceOp.SUM):
@@ -262,10 +268,12 @@ def barrier(group_name: str = "default", timeout: float = 120.0):
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    """Point-to-point send (ray parity: collective.py send)."""
+    """Point-to-point send (ray parity: collective.py send). Messages between
+    each (src, dst) pair are ordered by a dedicated channel counter, so
+    asymmetric patterns (rank0 sending to many peers) stay matched."""
     g = _group(group_name)
-    seq = g.seq
-    g.seq += 1
+    seq = g.p2p_send.get(dst_rank, 0)
+    g.p2p_send[dst_rank] = seq + 1
     key = f"{g.name}:p2p:{seq}:{g.rank}->{dst_rank}".encode()
     _kv_put(key, pickle.dumps(_to_numpy(tensor), protocol=5))
 
@@ -273,8 +281,8 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
 def recv(tensor, src_rank: int, group_name: str = "default",
          timeout: float = 120.0):
     g = _group(group_name)
-    seq = g.seq
-    g.seq += 1
+    seq = g.p2p_recv.get(src_rank, 0)
+    g.p2p_recv[src_rank] = seq + 1
     key = f"{g.name}:p2p:{seq}:{src_rank}->{g.rank}".encode()
     data = pickle.loads(_kv_wait(key, timeout))
     if isinstance(tensor, np.ndarray):
