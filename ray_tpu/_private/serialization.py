@@ -96,6 +96,9 @@ class TaskError(Exception):
         self.task_info = task_info
         super().__init__(str(cause))
 
+    def __reduce__(self):
+        return (type(self), (self.cause, self.remote_traceback, self.task_info))
+
     def __str__(self):
         return (
             f"{type(self.cause).__name__}: {self.cause}\n"
