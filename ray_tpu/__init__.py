@@ -18,13 +18,16 @@ from ray_tpu.api import (
     ActorHandle,
     RayContext,
     RemoteFunction,
+    available_resources,
     cancel,
+    cluster_resources,
     get,
     get_actor,
     init,
     is_initialized,
     kill,
     method,
+    nodes,
     put,
     remote,
     shutdown,
@@ -44,7 +47,10 @@ __all__ = [
     "RemoteFunction",
     "TaskCancelledError",
     "TaskError",
+    "available_resources",
     "cancel",
+    "cluster_resources",
+    "nodes",
     "get",
     "get_actor",
     "get_runtime_context",

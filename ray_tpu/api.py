@@ -183,6 +183,34 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     global_worker.core_worker.cancel_task(ref, force=force)
 
 
+def nodes() -> list:
+    """Cluster node table (ray parity: ray.nodes())."""
+    global_worker.check_connected()
+    return global_worker.core_worker.get_nodes()
+
+
+def cluster_resources() -> Dict[str, float]:
+    """Total resources across alive nodes (ray parity: ray.cluster_resources)."""
+    totals: Dict[str, float] = {}
+    for n in nodes():
+        if not n.get("alive", True):
+            continue
+        for k, v in (n.get("resources_total") or {}).items():
+            totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def available_resources() -> Dict[str, float]:
+    """Currently-free resources (ray parity: ray.available_resources)."""
+    avail: Dict[str, float] = {}
+    for n in nodes():
+        if not n.get("alive", True):
+            continue
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    return avail
+
+
 def get_actor(name: str, namespace: Optional[str] = None) -> "ActorHandle":
     global_worker.check_connected()
     table = global_worker.core_worker.get_actor_table(name=name, namespace=namespace)

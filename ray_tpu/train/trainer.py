@@ -50,7 +50,10 @@ class BaseTrainer:
             merged.update(config.get("train_loop_config", config) or {})
             t.train_loop_config = merged
 
-            from ray_tpu.train import session as session_mod
+            # Relay worker reports up through the Tune session so schedulers
+            # see intermediate results (falls through to the Train session
+            # when no Tune trial is active).
+            from ray_tpu.tune import session as session_mod
 
             def cb(metrics, checkpoint):
                 session_mod.report(metrics, checkpoint=checkpoint)

@@ -1,0 +1,85 @@
+"""ray_tpu.tune — hyperparameter search (ray parity: python/ray/tune/).
+
+Trials are actors on the ray_tpu runtime; a TPU trial's resource request is
+a whole slice-gang (e.g. {"TPU": 4}) so the scheduler packs it onto ICI.
+"""
+
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.logger import Callback, CSVLoggerCallback, JsonLoggerCallback
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qlograndint,
+    qloguniform,
+    qrandint,
+    qrandn,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.session import (
+    get_checkpoint,
+    get_trial_id,
+    get_trial_name,
+    get_trial_resources,
+    report,
+)
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
+from ray_tpu.tune.trainable import Trainable
+from ray_tpu.tune.tuner import (
+    TuneConfig,
+    Tuner,
+    run,
+    with_parameters,
+    with_resources,
+)
+
+__all__ = [
+    "Callback",
+    "CSVLoggerCallback",
+    "CombinedStopper",
+    "FunctionStopper",
+    "JsonLoggerCallback",
+    "MaximumIterationStopper",
+    "ResultGrid",
+    "Stopper",
+    "TimeoutStopper",
+    "Trainable",
+    "Trial",
+    "TrialPlateauStopper",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_id",
+    "get_trial_name",
+    "get_trial_resources",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "qlograndint",
+    "qloguniform",
+    "qrandint",
+    "qrandn",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "with_resources",
+]

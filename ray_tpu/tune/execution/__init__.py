@@ -1,0 +1,3 @@
+from ray_tpu.tune.execution.tune_controller import TuneController
+
+__all__ = ["TuneController"]

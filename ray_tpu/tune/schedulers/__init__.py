@@ -1,0 +1,19 @@
+from ray_tpu.tune.schedulers.async_hyperband import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+)
+from ray_tpu.tune.schedulers.hyperband import HyperBandForBOHB, HyperBandScheduler
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+from ray_tpu.tune.schedulers.trial_scheduler import FIFOScheduler, TrialScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "FIFOScheduler",
+    "HyperBandForBOHB",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+]

@@ -1,0 +1,41 @@
+"""HyperBand (ray parity: python/ray/tune/schedulers/hyperband.py).
+
+Implemented asynchronously: classic HyperBand's bracket schedule (s_max+1
+brackets, bracket s halving from r = max_t * rf^-s) mapped onto the ASHA
+rung mechanism, so trials never block waiting for a cohort — the
+TPU-friendly choice (keeps chips busy) with the same elimination profile.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ray_tpu.tune.schedulers.async_hyperband import AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        max_t: float = 81.0,
+        reduction_factor: float = 3.0,
+        stop_last_trials: bool = True,
+    ):
+        s_max = int(math.log(max(max_t, 1), reduction_factor))
+        super().__init__(
+            time_attr=time_attr,
+            metric=metric,
+            mode=mode,
+            max_t=max_t,
+            grace_period=1.0,
+            reduction_factor=reduction_factor,
+            brackets=s_max + 1,
+        )
+        self._stop_last_trials = stop_last_trials
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """BOHB's bracket scheduler; pair with a TPE-style searcher."""

@@ -1,0 +1,130 @@
+"""Population Based Training (ray parity:
+python/ray/tune/schedulers/pbt.py PopulationBasedTraining).
+
+Every ``perturbation_interval`` time units each trial's score is recorded.
+A trial in the bottom quantile exploits a top-quantile donor: it adopts the
+donor's latest checkpoint and an explored (mutated) version of the donor's
+config. The controller performs the actual stop → restore → restart dance
+via ``controller.exploit_trial``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+def _explore(
+    config: Dict,
+    mutations: Dict,
+    resample_probability: float,
+    custom_explore_fn: Optional[Callable],
+    rng: random.Random,
+) -> Dict:
+    new_config = dict(config)
+    for key, spec in mutations.items():
+        if key not in new_config:
+            continue
+        old = new_config[key]
+        if callable(getattr(spec, "sample", None)):
+            # Domain object
+            if rng.random() < resample_probability:
+                new_config[key] = spec.sample()
+            else:
+                new_config[key] = old * rng.choice([0.8, 1.2]) if isinstance(
+                    old, (int, float)
+                ) else spec.sample()
+        elif isinstance(spec, list):
+            if rng.random() < resample_probability or old not in spec:
+                new_config[key] = rng.choice(spec)
+            else:
+                i = spec.index(old)
+                shift = rng.choice([-1, 1])
+                new_config[key] = spec[max(0, min(len(spec) - 1, i + shift))]
+        elif callable(spec):
+            new_config[key] = spec()
+        if isinstance(old, int) and isinstance(new_config[key], float):
+            new_config[key] = int(new_config[key])
+    if custom_explore_fn:
+        new_config = custom_explore_fn(new_config)
+    return new_config
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: float = 10.0,
+        hyperparam_mutations: Optional[Dict] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        custom_explore_fn: Optional[Callable] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric, mode)
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._explore_fn = custom_explore_fn
+        self._rng = random.Random(seed)
+        # trial_id -> {"last_perturb_t": t, "score": latest score}
+        self._state: Dict[str, Dict] = {}
+        self.num_perturbations = 0
+
+    def on_trial_add(self, controller, trial):
+        self._state[trial.trial_id] = {"last_perturb_t": 0.0, "score": None}
+
+    def _quantiles(self):
+        scored = [
+            (tid, st["score"])
+            for tid, st in self._state.items()
+            if st["score"] is not None
+        ]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda kv: kv[1])
+        n = max(1, int(len(scored) * self._quantile))
+        if len(scored) <= n:
+            return [], []
+        bottom = [tid for tid, _ in scored[:n]]
+        top = [tid for tid, _ in scored[-n:]]
+        return bottom, top
+
+    def on_trial_result(self, controller, trial, result):
+        t = result.get(self._time_attr)
+        score = self._score(result)
+        st = self._state.setdefault(
+            trial.trial_id, {"last_perturb_t": 0.0, "score": None}
+        )
+        if score is not None:
+            st["score"] = score
+        if t is None or t - st["last_perturb_t"] < self._interval:
+            return TrialScheduler.CONTINUE
+        st["last_perturb_t"] = t
+        bottom, top = self._quantiles()
+        if trial.trial_id in bottom and top:
+            donor_id = self._rng.choice(top)
+            donor = controller.get_trial(donor_id)
+            if donor is None:
+                return TrialScheduler.CONTINUE
+            new_config = _explore(
+                donor.config,
+                self._mutations,
+                self._resample_prob,
+                self._explore_fn,
+                self._rng,
+            )
+            self.num_perturbations += 1
+            controller.exploit_trial(trial, donor, new_config)
+            # Controller restarted the trial; its in-flight future is void.
+            return TrialScheduler.CONTINUE
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, controller, trial, result):
+        self._state.pop(trial.trial_id, None)

@@ -1,0 +1,178 @@
+"""Tuner + TuneConfig (ray parity: python/ray/tune/tuner.py:53,
+tune/tune_config.py) and the legacy ``tune.run`` entry
+(tune/tune.py:295).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune.execution.tune_controller import TuneController
+from ray_tpu.tune.logger import DEFAULT_CALLBACKS
+from ray_tpu.tune.result_grid import ResultGrid
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+    num_samples: int = 1
+    search_alg: Any = None
+    scheduler: Any = None
+    max_concurrent_trials: int = 0
+    time_budget_s: Optional[float] = None
+    reuse_actors: bool = False
+
+
+class _ResourceWrapped:
+    """Result of tune.with_resources — trainable + resource request."""
+
+    def __init__(self, trainable, resources: Dict[str, float]):
+        self.trainable = trainable
+        self.resources = resources
+        self.__name__ = getattr(trainable, "__name__", "trainable")
+
+
+def with_resources(trainable, resources: Union[Dict[str, float], Any]):
+    """ray parity: tune.with_resources — attach a per-trial resource request.
+
+    Accepts a plain dict ({"CPU": 2, "TPU": 4}) or a ScalingConfig (its
+    worker bundle is used)."""
+    if hasattr(resources, "worker_resources"):
+        resources = resources.worker_resources()
+    return _ResourceWrapped(trainable, dict(resources))
+
+
+def with_parameters(trainable, **kwargs):
+    """ray parity: tune.with_parameters — bind large constants via the
+    object store so they're shipped once, not per-trial-config."""
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    if callable(trainable) and not isinstance(trainable, type):
+        def _inner(config):
+            bound = {k: ray_tpu.get(r) for k, r in refs.items()}
+            return trainable(config, **bound)
+
+        _inner.__name__ = getattr(trainable, "__name__", "trainable")
+        return _inner
+
+    class _Bound(trainable):  # type: ignore[misc]
+        def setup(self, config):
+            bound = {k: ray_tpu.get(r) for k, r in refs.items()}
+            super().setup(config, **bound)
+
+    _Bound.__name__ = trainable.__name__
+    return _Bound
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, type, Any] = None,
+        *,
+        param_space: Optional[Dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        resources = None
+        # Trainer instances (ray_tpu.train) wrap themselves into a trainable.
+        if hasattr(trainable, "as_trainable"):
+            trainer = trainable
+            resources = trainer.scaling_config.worker_resources()
+            if run_config is None:
+                run_config = trainer.run_config
+            trainable = trainer.as_trainable()
+        if isinstance(trainable, _ResourceWrapped):
+            resources = trainable.resources
+            trainable = trainable.trainable
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._resources = resources
+        self._controller: Optional[TuneController] = None
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        callbacks = [cls() for cls in DEFAULT_CALLBACKS]
+        callbacks += list(self._run_config.callbacks or [])
+        self._controller = TuneController(
+            self._trainable,
+            self._param_space,
+            metric=tc.metric,
+            mode=tc.mode,
+            num_samples=tc.num_samples,
+            search_alg=tc.search_alg,
+            scheduler=tc.scheduler,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            time_budget_s=tc.time_budget_s,
+            run_config=self._run_config,
+            trial_resources=self._resources,
+            reuse_actors=tc.reuse_actors,
+            callbacks=callbacks,
+        )
+        trials = self._controller.run()
+        return ResultGrid(
+            trials,
+            metric=tc.metric,
+            mode=tc.mode,
+            experiment_dir=self._controller.experiment_dir,
+        )
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        return False  # experiment-state restore lands with the syncer
+
+    def get_results(self) -> ResultGrid:
+        if self._controller is None:
+            raise RuntimeError("call fit() first")
+        tc = self._tune_config
+        return ResultGrid(
+            self._controller.trials, metric=tc.metric, mode=tc.mode,
+            experiment_dir=self._controller.experiment_dir,
+        )
+
+
+def run(
+    trainable,
+    *,
+    config: Optional[Dict] = None,
+    metric: Optional[str] = None,
+    mode: Optional[str] = None,
+    num_samples: int = 1,
+    search_alg=None,
+    scheduler=None,
+    stop=None,
+    resources_per_trial: Optional[Dict] = None,
+    max_concurrent_trials: int = 0,
+    time_budget_s: Optional[float] = None,
+    name: Optional[str] = None,
+    storage_path: Optional[str] = None,
+    **_ignored,
+) -> ResultGrid:
+    """Legacy entry (ray parity: tune.run, tune/tune.py:295)."""
+    rc = RunConfig(name=name, storage_path=storage_path, stop=stop)
+    t = trainable
+    if resources_per_trial:
+        res = {k.upper() if k in ("cpu", "gpu", "tpu") else k: v
+               for k, v in resources_per_trial.items()}
+        t = with_resources(trainable, res)
+    tuner = Tuner(
+        t,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            search_alg=search_alg,
+            scheduler=scheduler,
+            max_concurrent_trials=max_concurrent_trials,
+            time_budget_s=time_budget_s,
+        ),
+        run_config=rc,
+    )
+    return tuner.fit()

@@ -1,0 +1,264 @@
+"""Tune tests (analog of ray: python/ray/tune/tests/test_tune_*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+
+def test_sample_domains():
+    assert 0.0 <= tune.uniform(0, 1).sample() <= 1.0
+    assert 1 <= tune.loguniform(1, 100).sample() <= 100
+    v = tune.quniform(0, 10, 0.5).sample()
+    assert abs(v / 0.5 - round(v / 0.5)) < 1e-9
+    assert tune.randint(3, 7).sample() in range(3, 7)
+    assert tune.choice(["a", "b"]).sample() in ("a", "b")
+
+
+def test_variant_generation():
+    from ray_tpu.tune.search.variant_generator import (
+        count_variants,
+        generate_variants,
+    )
+
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.grid_search(["x", "y"]),
+        "c": tune.uniform(0, 1),
+    }
+    assert count_variants(space) == 6
+    variants = list(generate_variants(space))
+    assert len(variants) == 6
+    configs = [cfg for _, cfg in variants]
+    assert {(c["a"], c["b"]) for c in configs} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")
+    }
+    assert all(0 <= c["c"] <= 1 for c in configs)
+
+
+def test_tuner_function_trainable(ray_start_regular):
+    def objective(config):
+        score = (config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="min"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 5
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_multiple_reports_and_stop(ray_start_regular):
+    def objective(config):
+        for i in range(20):
+            tune.report({"iter": i, "loss": 1.0 / (i + 1)})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.1, 1.0)},
+        tune_config=tune.TuneConfig(num_samples=2, metric="loss", mode="min"),
+        run_config=ray_tpu.air.RunConfig(stop={"training_iteration": 5}),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    for res in grid:
+        assert res.metrics["training_iteration"] == 5
+
+
+def test_tuner_class_trainable(ray_start_regular):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.acc = 0.0
+
+        def step(self):
+            self.acc += self.x
+            return {"acc": self.acc, "done": self.acc >= 10 * self.x}
+
+        def save_checkpoint(self, checkpoint_dir=None):
+            return {"acc": self.acc}
+
+        def load_checkpoint(self, state):
+            self.acc = state["acc"]
+
+    tuner = tune.Tuner(
+        MyTrainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    best = grid.get_best_result()
+    assert best.metrics["acc"] == 20.0
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def objective(config):
+        for i in range(30):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(max_t=30, grace_period=2, reduction_factor=2),
+        ),
+    )
+    grid = tuner.fit()
+    iters = {
+        r.metrics["config"]["q"]: r.metrics["training_iteration"] for r in grid
+    }
+    # The best trial survives the full budget.
+    assert iters[2.0] == 30
+    assert grid.get_best_result().metrics["config"]["q"] == 2.0
+
+
+def test_median_stopping(ray_start_regular):
+    def objective(config):
+        for i in range(15):
+            tune.report({"score": config["lvl"]})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lvl": tune.grid_search([1.0, 1.0, 1.0, 0.0])},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=MedianStoppingRule(grace_period=3, min_samples_required=2),
+            max_concurrent_trials=4,
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+
+
+def test_pbt_exploits(ray_start_regular):
+    def objective(config):
+        score = tune.get_checkpoint()
+        base = score.to_dict()["score"] if score else 0.0
+        for i in range(12):
+            base += config["rate"]
+            tune.report(
+                {"score": base},
+                checkpoint=ray_tpu.air.Checkpoint.from_dict({"score": base}),
+            )
+
+    pbt = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.5, 2.0)},
+        seed=0,
+    )
+    tuner = tune.Tuner(
+        objective,
+        param_space={"rate": tune.grid_search([0.01, 0.02, 1.0, 1.5])},
+        tune_config=tune.TuneConfig(
+            scheduler=pbt, max_concurrent_trials=4, metric="score", mode="max"
+        ),
+        run_config=ray_tpu.air.RunConfig(stop={"training_iteration": 12}),
+    )
+    grid = tuner.fit()
+    assert pbt.num_perturbations > 0
+    assert grid.get_best_result().metrics["score"] > 1.0
+
+
+def test_with_resources_and_parameters(ray_start_regular):
+    big = list(range(1000))
+
+    def objective(config, data=None):
+        tune.report({"n": len(data) + config["x"]})
+
+    wrapped = tune.with_resources(
+        tune.with_parameters(objective, data=big), {"CPU": 1}
+    )
+    grid = tune.Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="n", mode="max"),
+    ).fit()
+    assert grid.get_best_result().metrics["n"] == 1002
+
+
+def test_trial_failure_marks_error(ray_start_regular):
+    def objective(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"ok": 1})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    ).fit()
+    assert grid.num_errors == 1
+    assert grid.num_terminated == 1
+
+
+def test_concurrency_limiter(ray_start_regular):
+    searcher = ConcurrencyLimiter(BasicVariantGenerator(), max_concurrent=1)
+
+    def objective(config):
+        tune.report({"v": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(
+            search_alg=searcher, metric="v", mode="max"
+        ),
+    ).fit()
+    assert len(grid) == 3
+
+
+def test_tune_run_legacy_api(ray_start_regular):
+    def objective(config):
+        tune.report({"m": config["x"] * 2})
+
+    grid = tune.run(
+        objective,
+        config={"x": tune.grid_search([1, 2])},
+        metric="m",
+        mode="max",
+        resources_per_trial={"cpu": 1},
+    )
+    assert grid.get_best_result().metrics["m"] == 4
+
+
+def test_tuner_over_trainer(ray_start_regular):
+    """Tuner(trainer) parity: sweep over train_loop_config."""
+    from ray_tpu import train
+
+    def loop(config):
+        for i in range(3):
+            train.report({"loss": config["lr"] * (3 - i)})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        scaling_config=ray_tpu.air.ScalingConfig(num_workers=1),
+    )
+    tuner = tune.Tuner(
+        trainer,
+        param_space={
+            "train_loop_config": {"lr": tune.grid_search([0.1, 0.01])}
+        },
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert grid.num_errors == 0
+    assert abs(grid.get_best_result().metrics["loss"] - 0.01) < 1e-9
