@@ -42,7 +42,6 @@ class _CallerQueue:
 class TaskExecutor:
     def __init__(self, core_worker):
         self.cw = core_worker
-        core_worker.executor = self
         self.pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec"
         )
@@ -55,6 +54,9 @@ class TaskExecutor:
         self._async_sem: Optional[asyncio.Semaphore] = None
         self.current_task_id: Optional[bytes] = None
         self.current_job_id: Optional[bytes] = None
+        # Publish last: the core worker's IO thread polls `executor` and may
+        # dispatch a task the instant it becomes visible.
+        core_worker.executor = self
 
     # ------------------------------------------------------------------
     def _ensure_user_loop(self):
