@@ -71,6 +71,7 @@ class TuneController:
         time_budget_s: Optional[float] = None,
         run_config: Optional[RunConfig] = None,
         trial_resources: Optional[Dict[str, float]] = None,
+        nested_resources: Optional[Dict[str, float]] = None,
         reuse_actors: bool = False,
         callbacks: Optional[list] = None,
     ):
@@ -109,6 +110,10 @@ class TuneController:
                 CombinedStopper(self._stopper, budget) if self._stopper else budget
             )
         self._resources = dict(trial_resources or {"CPU": 1.0})
+        # Resources claimed by actors the trial spawns internally (train
+        # workers under a trainer-built trainable). The trial actor itself
+        # must NOT claim these — they are only used to cap concurrency.
+        self._nested_resources = dict(nested_resources or {})
         self._reuse_actors = reuse_actors
         self._callbacks = list(callbacks or [])
         self._max_concurrent = max_concurrent_trials or self._default_concurrency()
@@ -125,9 +130,17 @@ class TuneController:
     # ------------------------------------------------------------------
     def _default_concurrency(self) -> int:
         try:
-            cpus = ray_tpu.cluster_resources().get("CPU", 0)
-            per_trial = max(self._resources.get("CPU", 1.0), 0.5)
-            return max(1, int(cpus / per_trial))
+            cluster = ray_tpu.cluster_resources()
+            bounds = []
+            for key in set(self._resources) | set(self._nested_resources):
+                per_trial = self._resources.get(key, 0.0) + self._nested_resources.get(
+                    key, 0.0
+                )
+                if per_trial > 0:
+                    bounds.append(int(cluster.get(key, 0) / per_trial))
+            if not bounds:
+                bounds.append(int(cluster.get("CPU", 0) / 0.5))
+            return max(1, min(bounds))
         except Exception:
             return max(os.cpu_count() or 4, 1)
 
@@ -372,12 +385,16 @@ class TuneController:
         running = len(self._actors)
         slots = self._max_concurrent - running
         out = []
-        for t in self.trials:
-            if slots <= 0:
-                break
-            if t.status in (Trial.PENDING, Trial.PAUSED) and t.trial_id not in self._actors:
-                out.append(t)
-                slots -= 1
+        # PENDING trials first; a PAUSED trial only resumes into a slot no
+        # pending trial wants, so PAUSE actually yields the actor (reference:
+        # scheduler choose_trial_to_run prefers fresh trials over paused).
+        for status in (Trial.PENDING, Trial.PAUSED):
+            for t in self.trials:
+                if slots <= 0:
+                    return out
+                if t.status == status and t.trial_id not in self._actors:
+                    out.append(t)
+                    slots -= 1
         return out
 
     def step(self):

@@ -7,6 +7,7 @@ from typing import List, Optional
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.result import Result
 from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.trainable import FN_CHECKPOINT_KEY
 
 
 class ResultGrid:
@@ -23,10 +24,16 @@ class ResultGrid:
         ckpt = None
         if trial.checkpoint is not None:
             state = trial.checkpoint.get("state")
-            if isinstance(state, dict) and state.get("data") is not None:
-                ckpt = Checkpoint.from_dict(state["data"])
-            elif state is not None:
-                ckpt = Checkpoint.from_dict({"state": state})
+            if isinstance(state, dict) and FN_CHECKPOINT_KEY in state:
+                # Function-trainable wrapper: unwrap what tune.report shipped;
+                # a trial that never reported a checkpoint yields None, not a
+                # truthy-but-empty Checkpoint.
+                data = state[FN_CHECKPOINT_KEY]
+                ckpt = Checkpoint.from_dict(data) if data is not None else None
+            elif isinstance(state, dict) and state:
+                # Class trainable: hand back exactly what save_checkpoint
+                # returned (same shape load_checkpoint receives).
+                ckpt = Checkpoint.from_dict(state)
         err = RuntimeError(trial.error_msg) if trial.error_msg else None
         return Result(
             metrics=trial.last_result or None,

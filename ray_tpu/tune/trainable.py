@@ -21,6 +21,9 @@ from ray_tpu.air.checkpoint import Checkpoint
 
 RESULT_DONE = "done"
 TRAINING_ITERATION = "training_iteration"
+# Marks a FunctionTrainable wrapper checkpoint; consumers (ResultGrid)
+# unwrap it rather than handing the wrapper dict to the user.
+FN_CHECKPOINT_KEY = "__fn_checkpoint__"
 
 
 class Trainable:
@@ -89,6 +92,11 @@ class Trainable:
             self.trial_info = trial_info
         if self.reset_config(new_config):
             self.config = dict(new_config)
+            # A reused actor starts a fresh trial: counters must not leak
+            # from the previous one (reference Trainable.reset does the same).
+            self._iteration = 0
+            self._time_total = 0.0
+            self._start_time = time.time()
             return True
         return False
 
@@ -118,9 +126,9 @@ class FunctionTrainable(Trainable):
         tune_session._init(
             reporter=self._report_from_fn,
             checkpoint=(
-                Checkpoint.from_dict(self._restore_checkpoint["data"])
+                Checkpoint.from_dict(self._restore_checkpoint[FN_CHECKPOINT_KEY])
                 if self._restore_checkpoint
-                and self._restore_checkpoint.get("data") is not None
+                and self._restore_checkpoint.get(FN_CHECKPOINT_KEY) is not None
                 else None
             ),
             stop_event=self._stop_event,
@@ -169,12 +177,14 @@ class FunctionTrainable(Trainable):
         return result
 
     def save_checkpoint(self, checkpoint_dir: Optional[str] = None) -> Optional[Dict]:
-        return {"data": self._last_checkpoint}
+        # Sentinel key so downstream consumers (ResultGrid) can tell this
+        # wrapper apart from a user-authored checkpoint dict.
+        return {FN_CHECKPOINT_KEY: self._last_checkpoint}
 
     def load_checkpoint(self, checkpoint: Optional[Dict]):
         self._restore_checkpoint = checkpoint
-        if checkpoint and checkpoint.get("data") is not None:
-            self._last_checkpoint = checkpoint["data"]
+        if checkpoint and checkpoint.get(FN_CHECKPOINT_KEY) is not None:
+            self._last_checkpoint = checkpoint[FN_CHECKPOINT_KEY]
 
     def cleanup(self):
         self._stop_event.set()

@@ -79,10 +79,24 @@ class Tuner:
         run_config: Optional[RunConfig] = None,
     ):
         resources = None
+        nested_resources = None
         # Trainer instances (ray_tpu.train) wrap themselves into a trainable.
+        # The trial actor only coordinates — its BackendExecutor spawns the
+        # actual train workers — so the trial claims trainer_resources
+        # (default none) while the worker bundles enter the concurrency cap
+        # as nested demand. Claiming worker bundles twice deadlocks the
+        # cluster (trial actors hoard resources their own workers need).
         if hasattr(trainable, "as_trainable"):
             trainer = trainable
-            resources = trainer.scaling_config.worker_resources()
+            sc = trainer.scaling_config
+            resources = dict(sc.trainer_resources or {})
+            # Explicit CPU 0: _actor_options defaults a missing CPU key to
+            # 1.0, which would quietly re-grow the coordinator's footprint.
+            resources.setdefault("CPU", 0.0)
+            per_worker = sc.worker_resources()
+            nested_resources = {
+                k: v * sc.num_workers for k, v in per_worker.items()
+            }
             if run_config is None:
                 run_config = trainer.run_config
             trainable = trainer.as_trainable()
@@ -94,6 +108,7 @@ class Tuner:
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
         self._resources = resources
+        self._nested_resources = nested_resources
         self._controller: Optional[TuneController] = None
 
     def fit(self) -> ResultGrid:
@@ -112,6 +127,7 @@ class Tuner:
             time_budget_s=tc.time_budget_s,
             run_config=self._run_config,
             trial_resources=self._resources,
+            nested_resources=self._nested_resources,
             reuse_actors=tc.reuse_actors,
             callbacks=callbacks,
         )

@@ -262,3 +262,40 @@ def test_tuner_over_trainer(ray_start_regular):
     assert len(grid) == 2
     assert grid.num_errors == 0
     assert abs(grid.get_best_result().metrics["loss"] - 0.01) < 1e-9
+
+
+def test_tuner_over_trainer_full_cluster(ray_start_regular):
+    """Train workers may claim the ENTIRE cluster: the trial actor must not
+    double-count worker bundles or the workers can never schedule
+    (regression: trial claimed a worker bundle on top of the executor's)."""
+    from ray_tpu import train
+
+    def loop(config):
+        train.report({"ok": 1.0})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        # 4 workers x 1 CPU == the whole ray_start_regular cluster.
+        scaling_config=ray_tpu.air.ScalingConfig(num_workers=4),
+    )
+    grid = tune.Tuner(trainer).fit()
+    assert len(grid) == 1
+    assert grid.num_errors == 0
+
+
+def test_function_trainable_without_checkpoint_has_none(ray_start_regular):
+    """A trial that never reports a checkpoint must yield Result.checkpoint
+    None even when checkpoint_at_end forces a save (regression: wrapper dict
+    leaked through as a truthy empty Checkpoint)."""
+
+    def loop(config):
+        tune.report({"x": 1.0})
+
+    grid = tune.Tuner(
+        loop,
+        run_config=ray_tpu.air.RunConfig(
+            checkpoint_config=ray_tpu.air.CheckpointConfig(checkpoint_at_end=True)
+        ),
+    ).fit()
+    assert grid.num_errors == 0
+    assert grid[0].checkpoint is None
