@@ -37,6 +37,26 @@ from ray_tpu.runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy subpackage access (ray parity: ray.data / ray.train / ... are
+    # importable attributes) without paying their import cost up front.
+    if name in ("data", "train", "tune", "serve", "air", "rllib", "util",
+                "workflow", "dag"):
+        import importlib
+
+        try:
+            mod = importlib.import_module(f"ray_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # keep hasattr()/getattr(default) semantics for not-yet-built
+            # subpackages
+            raise AttributeError(
+                f"module 'ray_tpu' has no attribute {name!r}"
+            ) from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
 __all__ = [
     "ActorClass",
     "ActorDiedError",

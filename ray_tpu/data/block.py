@@ -1,0 +1,265 @@
+"""Block layer: the unit of data a Dataset moves through the cluster.
+
+A block is a ``pyarrow.Table`` riding the shared-memory object store
+(zero-copy on read thanks to pickle5 out-of-band buffers). ``BlockAccessor``
+bundles the per-block operations the physical operators need.
+
+Reference parity: ray python/ray/data/block.py (BlockAccessor),
+_internal/arrow_block.py (ArrowBlockAccessor) — redesigned: one Arrow-only
+block type instead of the Arrow/pandas/simple triple, since TPU-host RAM is
+plentiful and Arrow → numpy is zero-copy for fixed-width types.
+"""
+
+from __future__ import annotations
+
+import builtins
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+# Batches cross the user boundary in one of these shapes.
+BatchFormat = ("pyarrow", "pandas", "numpy", "dict")
+
+# Tables with a single unnamed value column (simple datasets: range(),
+# from_items([1,2,3])) use this column name, like the reference's
+# TENSOR_COLUMN_NAME / "item" convention.
+VALUE_COL = "item"
+
+
+@dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: Optional[List[str]] = None
+
+    @staticmethod
+    def for_block(block: Block, input_files: Optional[List[str]] = None
+                  ) -> "BlockMetadata":
+        return BlockMetadata(
+            num_rows=block.num_rows,
+            size_bytes=block.nbytes,
+            schema=block.schema,
+            input_files=input_files,
+        )
+
+
+def _to_table(batch: Any) -> Block:
+    """Coerce any user-returned batch into an Arrow table."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if batch is None:
+        return pa.table({})
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            cols[k] = tensor_column(v) if (
+                isinstance(v, np.ndarray) and v.ndim > 1
+            ) else pa.array(v)
+        return pa.table(cols)
+    if isinstance(batch, list):
+        return rows_to_block(batch)
+    raise TypeError(f"cannot convert batch of type {type(batch)} to a block")
+
+
+def tensor_column(arr: np.ndarray) -> pa.Array:
+    """Store a (N, ...) ndarray as a fixed-shape tensor column so the
+    per-row shape survives the Arrow round-trip (reference parity:
+    the ArrowTensorArray extension type)."""
+    return pa.FixedShapeTensorArray.from_numpy_ndarray(np.ascontiguousarray(arr))
+
+
+def column_to_numpy(col) -> np.ndarray:
+    """Column -> ndarray, restoring tensor shapes for tensor columns."""
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    if isinstance(col.type, pa.FixedShapeTensorType):
+        return col.to_numpy_ndarray()
+    return np.asarray(col)
+
+
+def rows_to_block(rows: List[Any]) -> Block:
+    """Build a block from a list of rows (dicts or bare values)."""
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: pa.array(v) for k, v in cols.items()})
+    return pa.table({VALUE_COL: pa.array(rows)})
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+class BlockAccessor:
+    """Operations over one block (ray parity: data/block.py BlockAccessor)."""
+
+    def __init__(self, block: Block):
+        self._t = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- shape ---------------------------------------------------------
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._t.schema
+
+    def metadata(self) -> BlockMetadata:
+        return BlockMetadata.for_block(self._t)
+
+    # -- conversions ---------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        return self._t
+
+    def to_pandas(self):
+        return self._t.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        cols = columns or self._t.column_names
+        return {c: column_to_numpy(self._t.column(c)) for c in cols}
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("pyarrow", "arrow"):
+            return self._t
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("numpy", "dict"):
+            out = self.to_numpy()
+            if batch_format == "numpy" and set(out) == {VALUE_COL}:
+                return out[VALUE_COL]
+            return out
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterable[Any]:
+        simple = self._t.column_names == [VALUE_COL]
+        for chunk in self._t.to_pylist():
+            yield chunk[VALUE_COL] if simple else chunk
+
+    # -- slicing -------------------------------------------------------
+    def slice(self, start: int, end: int) -> Block:
+        return self._t.slice(start, end - start)
+
+    def take(self, indices: List[int]) -> Block:
+        return self._t.take(pa.array(indices))
+
+    def select(self, columns: List[str]) -> Block:
+        return self._t.select(columns)
+
+    def drop(self, columns: List[str]) -> Block:
+        keep = [c for c in self._t.column_names if c not in columns]
+        return self._t.select(keep)
+
+    def rename(self, mapping: Dict[str, str]) -> Block:
+        names = [mapping.get(c, c) for c in self._t.column_names]
+        return self._t.rename_columns(names)
+
+    # -- compute -------------------------------------------------------
+    def sort_by(self, key: Union[str, List[str]], descending: bool = False) -> Block:
+        keys = [key] if isinstance(key, str) else list(key)
+        order = "descending" if descending else "ascending"
+        return self._t.sort_by([(k, order) for k in keys])
+
+    def sample_boundaries(self, key: str, n: int) -> List[Any]:
+        """Sample n-1 split points for range partitioning."""
+        col = np.asarray(self._t.column(key))
+        if len(col) == 0 or n <= 1:
+            return []
+        qs = np.linspace(0, 1, n + 1)[1:-1]
+        return list(np.quantile(col, qs, method="nearest"))
+
+    def range_partition(self, key: str, boundaries: List[Any],
+                        descending: bool = False) -> List[Block]:
+        """Split into len(boundaries)+1 blocks by key ranges."""
+        if not boundaries:
+            return [self._t]
+        col = np.asarray(self._t.column(key))
+        idx = np.searchsorted(np.asarray(boundaries), col, side="right")
+        if descending:
+            idx = len(boundaries) - idx
+        return [self._t.filter(pa.array(idx == p))
+                for p in range(len(boundaries) + 1)]
+
+    def hash_partition(self, key: Union[str, List[str]], n: int) -> List[Block]:
+        if n <= 1:
+            return [self._t]
+        keys = [key] if isinstance(key, str) else list(key)
+        h = np.zeros(self._t.num_rows, dtype=np.uint64)
+        for k in keys:
+            col = self._t.column(k)
+            vals = col.to_pylist()
+            h = h * np.uint64(1000003) + np.array(
+                [hash(v) & 0xFFFFFFFFFFFF for v in vals], dtype=np.uint64
+            )
+        part = (h % np.uint64(n)).astype(np.int64)
+        return [self._t.filter(pa.array(part == p)) for p in range(n)]
+
+    def random_shuffle_indices(self, seed: Optional[int]) -> Block:
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._t.num_rows)
+        return self._t.take(pa.array(perm))
+
+    # -- aggregation helpers -------------------------------------------
+    def group_keys(self, keys: List[str]) -> List[tuple]:
+        cols = [self._t.column(k).to_pylist() for k in keys]
+        return list(dict.fromkeys(zip(*cols))) if cols else []
+
+    def filter_by_key(self, keys: List[str], value: tuple) -> Block:
+        mask = np.ones(self._t.num_rows, dtype=bool)
+        for k, v in zip(keys, value):
+            mask &= np.asarray(
+                pa.compute.equal(self._t.column(k), pa.scalar(v)).combine_chunks()
+            )
+        return self._t.filter(pa.array(mask))
+
+
+class DelegatingBlockBuilder:
+    """Accumulate rows / batches into output blocks capped at a target size
+    (ray parity: _internal/delegating_block_builder.py)."""
+
+    def __init__(self, target_rows: Optional[int] = None):
+        self._rows: List[Any] = []
+        self._tables: List[Block] = []
+        self._target = target_rows
+
+    def add(self, row: Any):
+        self._rows.append(row)
+
+    def add_batch(self, batch: Any):
+        self._flush_rows()
+        self._tables.append(_to_table(batch))
+
+    def _flush_rows(self):
+        if self._rows:
+            self._tables.append(rows_to_block(self._rows))
+            self._rows = []
+
+    def num_rows(self) -> int:
+        return sum(t.num_rows for t in self._tables) + len(self._rows)
+
+    def build(self) -> Block:
+        self._flush_rows()
+        return concat_blocks(self._tables)

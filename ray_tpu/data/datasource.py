@@ -1,0 +1,215 @@
+"""Datasources: build per-task read closures and write blocks out.
+
+Reference parity: ray python/ray/data/datasource/ (file_based_datasource.py,
+parquet_datasource.py, ...) — compressed to closure-returning factories: a
+``ReadTask`` here is just a zero-arg callable returning one block, shipped
+to a remote task by the executor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import (VALUE_COL, column_to_numpy,
+                                rows_to_block, tensor_column)
+
+ReadTask = Callable[[], pa.Table]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if not f.startswith((".", "_")):
+                        out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files under {paths}")
+    return out
+
+
+def _chunk(items: List[Any], n: int) -> List[List[Any]]:
+    n = max(1, min(n, len(items)))
+    size, rem = divmod(len(items), n)
+    chunks, i = [], 0
+    for k in range(n):
+        step = size + (1 if k < rem else 0)
+        if step:
+            chunks.append(items[i : i + step])
+        i += step
+    return chunks
+
+
+# -- readers ------------------------------------------------------------
+
+def range_tasks(n: int, parallelism: int) -> List[ReadTask]:
+    tasks = []
+    per = max(1, -(-n // max(parallelism, 1)))
+    start = 0
+    while start < n:
+        end = min(start + per, n)
+
+        def read(s=start, e=end):
+            return pa.table({VALUE_COL: pa.array(np.arange(s, e))})
+
+        tasks.append(read)
+        start = end
+    return tasks
+
+
+def range_tensor_tasks(n: int, shape: tuple, parallelism: int) -> List[ReadTask]:
+    tasks = []
+    per = max(1, -(-n // max(parallelism, 1)))
+    start = 0
+    while start < n:
+        end = min(start + per, n)
+
+        def read(s=start, e=end, shape=shape):
+            flat = int(np.prod(shape))
+            data = (
+                np.arange(s, e, dtype=np.int64)
+                .repeat(flat)
+                .reshape(e - s, *shape)
+            )
+            return pa.table({"data": tensor_column(data)})
+
+        tasks.append(read)
+        start = end
+    return tasks
+
+
+def items_tasks(items: List[Any], parallelism: int) -> List[ReadTask]:
+    return [
+        (lambda chunk=chunk: rows_to_block(chunk))
+        for chunk in _chunk(list(items), parallelism)
+    ]
+
+
+def parquet_tasks(paths, parallelism: int,
+                  columns: Optional[List[str]] = None) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            import pyarrow.parquet as pq
+
+            tables = [pq.read_table(f, columns=columns) for f in group]
+            return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def csv_tasks(paths, parallelism: int, **arrow_csv_kwargs) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            import pyarrow.csv as pcsv
+
+            tables = [pcsv.read_csv(f, **arrow_csv_kwargs) for f in group]
+            return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def json_tasks(paths, parallelism: int) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            import pyarrow.json as pjson
+
+            tables = [pjson.read_json(f) for f in group]
+            return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def numpy_tasks(paths, parallelism: int) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            arrs = [np.load(f) for f in group]
+            arr = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+            if arr.ndim == 1:
+                return pa.table({"data": pa.array(arr)})
+            return pa.table({"data": tensor_column(arr)})
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def binary_tasks(paths, parallelism: int,
+                 include_paths: bool = False) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            rows = []
+            for f in group:
+                with open(f, "rb") as fh:
+                    row: Dict[str, Any] = {"bytes": fh.read()}
+                if include_paths:
+                    row["path"] = f
+                rows.append(row)
+            return rows_to_block(rows)
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+# -- writers ------------------------------------------------------------
+
+def write_block_parquet(block: pa.Table, path: str, idx: int) -> str:
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.parquet")
+    pq.write_table(block, out)
+    return out
+
+
+def write_block_csv(block: pa.Table, path: str, idx: int) -> str:
+    import pyarrow.csv as pcsv
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.csv")
+    pcsv.write_csv(block, out)
+    return out
+
+
+def write_block_json(block: pa.Table, path: str, idx: int) -> str:
+    import json
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.json")
+    with open(out, "w") as fh:
+        for row in block.to_pylist():
+            fh.write(json.dumps(row, default=str) + "\n")
+    return out
+
+
+def write_block_numpy(block: pa.Table, path: str, idx: int,
+                      column: str = "data") -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.npy")
+    np.save(out, column_to_numpy(block.column(column)))
+    return out

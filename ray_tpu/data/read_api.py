@@ -1,0 +1,107 @@
+"""Read API: the ``ray_tpu.data.read_* / from_*`` entry points
+(ray parity: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import datasource as ds
+from ray_tpu.data.block import BlockMetadata, rows_to_block, tensor_column
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_PARALLELISM = 8
+
+
+def _par(parallelism: int) -> int:
+    return parallelism if parallelism and parallelism > 0 else DEFAULT_PARALLELISM
+
+
+def range(n: int, *, parallelism: int = -1, **_kw) -> Dataset:  # noqa: A001
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.range_tasks(n, p), p)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1,
+                 **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.range_tensor_tasks(n, shape, p), p)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1, **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.items_tasks(items, p), p)
+
+
+def read_parquet(paths, *, parallelism: int = -1,
+                 columns: Optional[List[str]] = None, **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.parquet_tasks(paths, p, columns), p)
+
+
+def read_csv(paths, *, parallelism: int = -1, **arrow_csv_kwargs) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.csv_tasks(paths, p, **arrow_csv_kwargs), p)
+
+
+def read_json(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.json_tasks(paths, p), p)
+
+
+def read_numpy(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.numpy_tasks(paths, p), p)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1, **_kw) -> Dataset:
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.binary_tasks(paths, p, include_paths), p)
+
+
+def from_pandas(dfs, *, parallelism: int = -1) -> Dataset:
+    import ray_tpu
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    bundles = []
+    for df in dfs:
+        t = pa.Table.from_pandas(df, preserve_index=False)
+        bundles.append((ray_tpu.put(t), BlockMetadata.for_block(t)))
+    return Dataset.from_bundles(bundles)
+
+
+def from_numpy(arrays, *, column: str = "data", parallelism: int = -1) -> Dataset:
+    import ray_tpu
+
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    bundles = []
+    for arr in arrays:
+        if arr.ndim == 1:
+            t = pa.table({column: pa.array(arr)})
+        else:
+            t = pa.table({column: tensor_column(arr)})
+        bundles.append((ray_tpu.put(t), BlockMetadata.for_block(t)))
+    return Dataset.from_bundles(bundles)
+
+
+def from_arrow(tables, *, parallelism: int = -1) -> Dataset:
+    import ray_tpu
+
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset.from_bundles(
+        [(ray_tpu.put(t), BlockMetadata.for_block(t)) for t in tables]
+    )
+
+
+def from_arrow_refs(refs: List[Any]) -> Dataset:
+    import ray_tpu
+
+    return Dataset.from_bundles(
+        [(r, BlockMetadata.for_block(ray_tpu.get(r))) for r in refs]
+    )

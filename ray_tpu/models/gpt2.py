@@ -31,6 +31,13 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # "auto": jax.nn.dot_product_attention (fused flash on TPU backends);
+    # "flash": ray_tpu.ops Pallas/scan flash kernel;
+    # "ring": sequence-parallel ring attention — the model must run inside
+    # shard_map with mesh axis ``sp_axis`` sharding the sequence dim
+    # (use build_train_step_sp).
+    attention: str = "auto"
+    sp_axis: str = "sp"
 
     @classmethod
     def gpt2_124m(cls, **kw):
@@ -62,9 +69,24 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, heads, C // heads)
         k = k.reshape(B, T, heads, C // heads)
         v = v.reshape(B, T, heads, C // heads)
-        # jax.nn.dot_product_attention lowers to fused (splash/flash)
-        # attention on TPU backends.
-        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        if c.attention == "ring":
+            from ray_tpu.ops import ring_attention
+
+            bhsd = lambda t: t.transpose(0, 2, 1, 3)
+            y = ring_attention(
+                bhsd(q), bhsd(k), bhsd(v), axis_name=c.sp_axis, causal=True
+            ).transpose(0, 2, 1, 3)
+        elif c.attention == "flash":
+            from ray_tpu.ops import flash_attention
+
+            bhsd = lambda t: t.transpose(0, 2, 1, 3)
+            y = flash_attention(
+                bhsd(q), bhsd(k), bhsd(v), causal=True
+            ).transpose(0, 2, 1, 3)
+        else:
+            # jax.nn.dot_product_attention lowers to fused (splash/flash)
+            # attention on TPU backends.
+            y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         y = y.reshape(B, T, C)
         return nn.Dense(C, dtype=c.dtype, name="c_proj")(y)
 
@@ -104,7 +126,12 @@ class GPT2(nn.Module):
         B, T = input_ids.shape
         wte = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte")
         wpe = nn.Embed(c.n_positions, c.n_embd, dtype=c.dtype, name="wpe")
-        x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
+        pos = jnp.arange(T)[None, :]
+        if c.attention == "ring":
+            # under shard_map T is the LOCAL sequence chunk; offset to
+            # global positions for this sequence shard
+            pos = pos + jax.lax.axis_index(c.sp_axis) * T
+        x = wte(input_ids) + wpe(pos)
         block = Block
         if c.remat:
             block = nn.remat(Block, static_argnums=(2,))
@@ -131,7 +158,12 @@ def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
                      weight_decay: float = 0.1):
     model = GPT2(config)
     dummy = jnp.zeros((1, min(8, config.n_positions)), dtype=jnp.int32)
-    params = model.init(rng, dummy)["params"]
+    init_model = model
+    if config.attention == "ring":
+        # ring attention needs a bound mesh axis; param shapes don't depend
+        # on the attention impl, so initialize outside shard_map without it
+        init_model = GPT2(dataclasses.replace(config, attention="auto"))
+    params = init_model.init(rng, dummy)["params"]
     tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay)
     opt_state = tx.init(params)
     return model, params, tx, opt_state
@@ -154,6 +186,39 @@ def build_train_step(model, tx, donate: bool = True):
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def build_train_step_sp(model, tx, mesh: Mesh, *, sp_axis: str = "sp",
+                        batch_axis: str = "data", donate: bool = True):
+    """Sequence-parallel train step: batch dim sharded over ``batch_axis``,
+    sequence dim over ``sp_axis`` (ring attention on the ICI ring inside
+    shard_map); params replicated, gradients pmean'd over both axes.
+
+    The model must have been built with ``attention="ring"``.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    axes = (batch_axis, sp_axis)
+
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+        grads = jax.lax.pmean(grads, axes)
+        loss = jax.lax.pmean(loss, axes)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    bspec = PartitionSpec(batch_axis, sp_axis)
+    fn = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec(),
+                  {"input_ids": bspec, "labels": bspec}),
+        out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec()),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 def shard_train_state(params, opt_state, mesh: Mesh, fsdp: bool = False):

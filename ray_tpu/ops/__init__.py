@@ -1,0 +1,18 @@
+"""ray_tpu.ops — TPU kernels (Pallas) and sequence-parallel attention."""
+
+from ray_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    finalize_flash,
+    online_block_update,
+)
+from ray_tpu.ops.ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "attention_reference",
+    "finalize_flash",
+    "flash_attention",
+    "online_block_update",
+    "ring_attention",
+    "ring_self_attention",
+]

@@ -8,7 +8,8 @@ from ray_tpu.air.config import (
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig, TorchConfig
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
-from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
 from ray_tpu.train.trainer import (
     BaseTrainer,
     DataParallelTrainer,
@@ -36,6 +37,7 @@ __all__ = [
     "TrainingFailedError",
     "WorkerGroup",
     "get_checkpoint",
+    "get_dataset_shard",
     "get_context",
     "report",
 ]

@@ -64,6 +64,7 @@ class _Session:
         self.queue: "queue.Queue" = queue.Queue()
         self.loaded_checkpoint = loaded_checkpoint
         self.stop_requested = threading.Event()
+        self.dataset_shards: Dict[str, Any] = {}
 
 
 _session: Optional[_Session] = None
@@ -110,6 +111,15 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
 def get_checkpoint() -> Optional[Checkpoint]:
     s = _session
     return s.loaded_checkpoint if s else None
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    """ray parity: ray.train.get_dataset_shard — this worker's streaming
+    split of the Dataset passed to the trainer's ``datasets=``."""
+    s = _session
+    if s is None:
+        return None
+    return s.dataset_shards.get(dataset_name)
 
 
 def get_context() -> TrainContext:

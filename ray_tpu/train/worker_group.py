@@ -50,6 +50,12 @@ class TrainWorker:
     def start_training(self, train_fn: Callable, config: dict):
         assert self._session is not None, "setup_session must run first"
         sess = self._session
+        shards = config.pop("__datasets__", None)
+        if shards:
+            rank = sess.ctx.get_world_rank()
+            sess.dataset_shards = {
+                name: splits[rank] for name, splits in shards.items()
+            }
 
         def _run():
             try:
