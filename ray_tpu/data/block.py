@@ -206,13 +206,20 @@ class BlockAccessor:
     def hash_partition(self, key: Union[str, List[str]], n: int) -> List[Block]:
         if n <= 1:
             return [self._t]
+        import zlib
+
         keys = [key] if isinstance(key, str) else list(key)
         h = np.zeros(self._t.num_rows, dtype=np.uint64)
         for k in keys:
             col = self._t.column(k)
             vals = col.to_pylist()
+            # crc32 of the value repr: deterministic ACROSS PROCESSES —
+            # builtin hash() is salted per interpreter, which would split
+            # one group over several partitions when map tasks run in
+            # different workers.
             h = h * np.uint64(1000003) + np.array(
-                [hash(v) & 0xFFFFFFFFFFFF for v in vals], dtype=np.uint64
+                [zlib.crc32(repr(v).encode()) for v in vals],
+                dtype=np.uint64,
             )
         part = (h % np.uint64(n)).astype(np.int64)
         return [self._t.filter(pa.array(part == p)) for p in range(n)]

@@ -356,7 +356,7 @@ class Dataset:
         for b in self.iter_batches(batch_size=batch_size,
                                    batch_format=batch_format):
             return b
-        raise StopIteration("empty dataset")
+        raise ValueError("dataset is empty, cannot take a batch")
 
     def show(self, n: int = 20):
         for row in self.take(n):

@@ -159,6 +159,9 @@ class _SplitCoordinator:
                     if self._done:
                         return None
                 else:  # epoch > self._epoch: previous epoch must finish
+                    # a consumer moving on abandons its own leftovers
+                    # (early break mid-epoch must not deadlock the advance)
+                    self._queues[consumer].clear()
                     if self._done and not any(self._queues):
                         self._epoch = epoch
                         self._done = False

@@ -1,0 +1,81 @@
+"""Shared Serve types.
+
+Reference parity: ray python/ray/serve/_private/common.py — deployment
+config records plus the request envelope the proxy hands to ingress
+replicas (the reference passes a Starlette Request; this runtime has no
+ASGI dependency on the replica side, so requests travel as a small
+picklable object)."""
+
+from __future__ import annotations
+
+import json as _json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+DEFAULT_APP_NAME = "default"
+
+
+@dataclass
+class Request:
+    """HTTP request envelope delivered to ingress deployments."""
+
+    method: str = "GET"
+    path: str = "/"
+    query: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+@dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    user_config: Optional[Any] = None
+    health_check_period_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    def replica_actor_options(self) -> Dict[str, Any]:
+        opts = dict(self.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        return opts
+
+
+@dataclass
+class ReplicaInfo:
+    replica_id: str
+    actor_name: str
+    deployment: str
+    app: str
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> Optional["AutoscalingConfig"]:
+        if d is None:
+            return None
+        known = {k: v for k, v in d.items()
+                 if k in cls.__dataclass_fields__}
+        # accept the reference's names
+        if "target_num_ongoing_requests_per_replica" in d:
+            known["target_ongoing_requests"] = d[
+                "target_num_ongoing_requests_per_replica"
+            ]
+        return cls(**known)

@@ -1,0 +1,91 @@
+"""@serve.batch — coalesce concurrent single calls into one batched call.
+
+Reference parity: ray python/ray/serve/batching.py — an async decorator:
+callers await individual results; the wrapper buffers requests until
+``max_batch_size`` or ``batch_wait_timeout_s`` and invokes the wrapped
+function once with the list, distributing results back per-caller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.pending: List[tuple] = []  # (item, future)
+        self.flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, item: Any):
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.pending.append((item, fut))
+        if len(self.pending) >= self.max_batch_size:
+            await self._flush()
+        elif self.flusher is None or self.flusher.done():
+            self.flusher = loop.create_task(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self):
+        await asyncio.sleep(self.timeout_s)
+        await self._flush()
+
+    async def _flush(self):
+        if not self.pending:
+            return
+        batch, self.pending = self.pending, []
+        items = [b[0] for b in batch]
+        futs = [b[1] for b in batch]
+        try:
+            out = self.fn(items)
+            if asyncio.iscoroutine(out):
+                out = await out
+            if len(out) != len(items):
+                raise ValueError(
+                    f"batched function returned {len(out)} results for "
+                    f"{len(items)} inputs"
+                )
+            for f, r in zip(futs, out):
+                if not f.done():
+                    f.set_result(r)
+        except Exception as e:  # noqa: BLE001 — propagate per-caller
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01, **_ignored):
+    """ray parity: @serve.batch."""
+
+    def decorate(fn):
+        queues = {}  # per (instance or None)
+
+        if asyncio.iscoroutinefunction(fn) or True:
+            @functools.wraps(fn)
+            async def wrapper(*args):
+                if len(args) == 2:  # bound method: (self, item)
+                    inst, item = args
+                    call = functools.partial(fn, inst)
+                    key = id(inst)
+                else:
+                    (item,) = args
+                    call = fn
+                    key = None
+                q = queues.get(key)
+                if q is None:
+                    q = _BatchQueue(call, max_batch_size,
+                                    batch_wait_timeout_s)
+                    queues[key] = q
+                return await q.submit(item)
+
+            return wrapper
+
+    if _func is not None:
+        return decorate(_func)
+    return decorate

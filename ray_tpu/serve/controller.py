@@ -1,0 +1,331 @@
+"""ServeController: the reconciliation brain of Serve.
+
+Reference parity: ray python/ray/serve/controller.py:75 (ServeController) +
+_private/deployment_state.py (replica-set reconciliation, rolling updates)
++ _private/autoscaling_policy.py — one named actor owning the desired app
+specs, running a control loop that (a) starts/stops replica actors to match
+target counts, (b) health-checks them, (c) autoscales replica counts from
+per-replica ongoing-request metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve._common import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    ReplicaInfo,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROL_LOOP_PERIOD_S = 0.25
+
+
+class _DeploymentState:
+    def __init__(self, app: str, config: DeploymentConfig,
+                 serialized_init: bytes):
+        self.app = app
+        self.config = config
+        self.serialized_init = serialized_init
+        self.replicas: Dict[str, Any] = {}  # actor_name -> handle
+        self.target = config.num_replicas
+        self.autoscaling = AutoscalingConfig.from_dict(
+            config.autoscaling_config
+        )
+        if self.autoscaling:
+            self.target = self.autoscaling.min_replicas
+        self.version = uuid.uuid4().hex[:8]
+        # replicas of the previous version, kept serving until the new
+        # version reaches its target (rolling update)
+        self.draining: Dict[str, Any] = {}
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+        self.consecutive_start_failures = 0
+        self.broken = False  # too many failed starts: stop retrying
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+
+class ServeController:
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True
+        )
+        self._loop_thread.start()
+
+    # ------------------------------------------------------------------
+    # API called by serve.run / serve.delete / handles / proxy
+    # ------------------------------------------------------------------
+    def deploy_app(self, app_name: str, deployments: List[dict],
+                   ingress: str, route_prefix: Optional[str]):
+        with self._lock:
+            old = self._apps.get(app_name, {})
+            new: Dict[str, _DeploymentState] = {}
+            for d in deployments:
+                cfg: DeploymentConfig = d["config"]
+                st = old.get(cfg.name)
+                if st is not None and st.serialized_init == d["init"] and \
+                        st.config == cfg:
+                    new[cfg.name] = st  # unchanged: keep replicas
+                else:
+                    fresh = _DeploymentState(app_name, cfg, d["init"])
+                    if st is not None:
+                        # rolling update: old replicas serve until the new
+                        # version is at target, then drain
+                        fresh.draining = {**st.draining, **st.replicas}
+                    new[cfg.name] = fresh
+            for name, st in old.items():
+                if name not in new:
+                    self._stop_all(st)
+            self._apps[app_name] = new
+            self._app_meta = getattr(self, "_app_meta", {})
+            self._app_meta[app_name] = {
+                "ingress": ingress,
+                "route_prefix": route_prefix if route_prefix is not None
+                else f"/{app_name}" if app_name != "default" else "/",
+            }
+        return True
+
+    def delete_app(self, app_name: str):
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            getattr(self, "_app_meta", {}).pop(app_name, None)
+            if app:
+                for st in app.values():
+                    self._stop_all(st)
+        return True
+
+    def wait_for_ready(self, app_name: str, timeout_s: float = 60.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                app = self._apps.get(app_name)
+                if app is not None and all(
+                    len(st.replicas) >= st.target for st in app.values()
+                ):
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def get_replica_names(self, app_name: str, deployment: str) -> List[str]:
+        with self._lock:
+            app = self._apps.get(app_name) or {}
+            st = app.get(deployment)
+            if st is None:
+                return []
+            # during a rolling update, route to the old version until the
+            # new one has live replicas
+            return list(st.replicas.keys()) or list(st.draining.keys())
+
+    def get_routes(self) -> Dict[str, tuple]:
+        """route_prefix -> (app_name, ingress deployment)."""
+        with self._lock:
+            meta = getattr(self, "_app_meta", {})
+            return {
+                m["route_prefix"]: (app, m["ingress"])
+                for app, m in meta.items()
+                if app in self._apps
+            }
+
+    def get_serve_status(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            for app, deps in self._apps.items():
+                out[app] = {
+                    "deployments": {
+                        name: {
+                            "target_replicas": st.target,
+                            "running_replicas": len(st.replicas),
+                            "version": st.version,
+                        }
+                        for name, st in deps.items()
+                    },
+                    **getattr(self, "_app_meta", {}).get(app, {}),
+                }
+            return out
+
+    def shutdown(self):
+        self._shutdown.set()
+        with self._lock:
+            for app in list(self._apps):
+                self.delete_app(app)
+        return True
+
+    # ------------------------------------------------------------------
+    # reconciliation
+    # ------------------------------------------------------------------
+    def _control_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001 — loop must survive
+                logger.exception("serve control loop iteration failed")
+            self._shutdown.wait(CONTROL_LOOP_PERIOD_S)
+
+    def _reconcile_once(self):
+        import ray_tpu
+
+        with self._lock:
+            states = [
+                st for app in self._apps.values() for st in app.values()
+            ]
+        for st in states:
+            # scale up (bounded per pass; a constructor that keeps failing
+            # marks the deployment broken instead of spinning the loop and
+            # starving every other deployment)
+            while len(st.replicas) < st.target and not st.broken:
+                name = (
+                    f"SERVE_REPLICA::{st.app}#{st.name}#"
+                    f"{uuid.uuid4().hex[:6]}"
+                )
+                from ray_tpu.serve.replica import Replica
+
+                opts = st.config.replica_actor_options()
+                actor_cls = ray_tpu.remote(
+                    name=name,
+                    max_concurrency=st.config.max_ongoing_requests,
+                    **opts,
+                )(Replica)
+                handle = actor_cls.remote(
+                    st.serialized_init, st.name, st.app,
+                    st.config.user_config, st.config.max_ongoing_requests,
+                )
+                # block until constructed so wait_for_ready means servable
+                try:
+                    ray_tpu.get(handle.check_health.remote(), timeout=60)
+                except Exception:
+                    logger.exception("replica %s failed to start", name)
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+                    st.consecutive_start_failures += 1
+                    if st.consecutive_start_failures >= 3:
+                        logger.error(
+                            "deployment %s/%s: %d consecutive replica start "
+                            "failures; giving up until redeployed",
+                            st.app, st.name, st.consecutive_start_failures,
+                        )
+                        st.broken = True
+                    break
+                st.consecutive_start_failures = 0
+                with self._lock:
+                    st.replicas[name] = handle
+            # rolling update: drain old-version replicas once at target
+            if st.draining and len(st.replicas) >= st.target:
+                with self._lock:
+                    drained, st.draining = dict(st.draining), {}
+                for handle in drained.values():
+                    self._graceful_stop(st, handle)
+            # scale down
+            while len(st.replicas) > st.target:
+                with self._lock:
+                    name, handle = next(iter(st.replicas.items()))
+                    del st.replicas[name]
+                self._graceful_stop(st, handle)
+            # health check
+            for name, handle in list(st.replicas.items()):
+                try:
+                    ray_tpu.get(handle.check_health.remote(), timeout=30)
+                except Exception:
+                    logger.warning("replica %s unhealthy; replacing", name)
+                    with self._lock:
+                        st.replicas.pop(name, None)
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+
+    def _autoscale_once(self):
+        import ray_tpu
+
+        with self._lock:
+            states = [
+                st for app in self._apps.values() for st in app.values()
+                if st.autoscaling
+            ]
+        for st in states:
+            ac = st.autoscaling
+            handles = list(st.replicas.values())
+            if not handles:
+                continue
+            try:
+                metrics = ray_tpu.get(
+                    [h.get_metrics.remote() for h in handles], timeout=10
+                )
+            except Exception:
+                continue
+            ongoing = sum(m["ongoing"] for m in metrics)
+            desired = max(
+                ac.min_replicas,
+                min(
+                    ac.max_replicas,
+                    int(-(-ongoing // max(ac.target_ongoing_requests, 1e-9)))
+                    if ongoing else ac.min_replicas,
+                ),
+            )
+            now = time.time()
+            if desired > st.target and now - st._last_scale_up >= ac.upscale_delay_s:
+                st.target = desired
+                st._last_scale_up = now
+            elif desired < st.target and \
+                    now - st._last_scale_down >= ac.downscale_delay_s:
+                st.target = desired
+                st._last_scale_down = now
+
+    # ------------------------------------------------------------------
+    def _graceful_stop(self, st: _DeploymentState, handle):
+        import ray_tpu
+
+        try:
+            ray_tpu.get(
+                handle.prepare_shutdown.remote(
+                    st.config.graceful_shutdown_timeout_s
+                ),
+                timeout=st.config.graceful_shutdown_timeout_s + 5,
+            )
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _stop_all(self, st: _DeploymentState):
+        for handle in list(st.replicas.values()) + list(st.draining.values()):
+            self._graceful_stop(st, handle)
+        st.replicas.clear()
+        st.draining.clear()
+
+    # ------------------------------------------------------------------
+    # HTTP proxy management
+    # ------------------------------------------------------------------
+    def ensure_proxy(self, host: str, port: int) -> int:
+        import ray_tpu
+
+        with self._lock:
+            if self._proxy is not None:
+                return self._proxy_port
+            from ray_tpu.serve.proxy import HTTPProxy
+
+            proxy_cls = ray_tpu.remote(num_cpus=0, name="SERVE_PROXY",
+                                       max_concurrency=1000)(HTTPProxy)
+            self._proxy = proxy_cls.remote(host, port)
+            self._proxy_port = ray_tpu.get(
+                self._proxy.ready.remote(), timeout=60
+            )
+            return self._proxy_port

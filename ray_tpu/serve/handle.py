@@ -1,0 +1,144 @@
+"""DeploymentHandle: the client-side router to a deployment's replicas.
+
+Reference parity: ray python/ray/serve/handle.py (DeploymentHandle /
+DeploymentResponse) + _private/router.py:262 (PowerOfTwoChoicesReplicaScheduler)
+— the handle keeps a local in-flight count per replica and picks the less
+loaded of two random replicas; the replica set refreshes from the
+controller on an interval and immediately on routing failures.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve._common import SERVE_CONTROLLER_NAME
+
+_REFRESH_PERIOD_S = 1.0
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (ray parity:
+    serve.handle.DeploymentResponse)."""
+
+    def __init__(self, ref, on_settle=None):
+        self._ref = ref
+        self._on_settle = on_settle
+        self._settled = False
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            if self._on_settle:
+                self._on_settle()
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+
+    @property
+    def ref(self):
+        self._settle()
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method = method_name
+        self._replicas: List[Any] = []
+        self._inflight: Dict[str, int] = {}
+        self._last_refresh = 0.0
+
+    # handles are pickled into other replicas; drop live actor handles
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_replicas"] = []
+        d["_inflight"] = {}
+        d["_last_refresh"] = 0.0
+        return d
+
+    def options(self, *, method_name: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self._method)
+        h._replicas = self._replicas
+        h._inflight = self._inflight
+        h._last_refresh = self._last_refresh
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    # ------------------------------------------------------------------
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and self._replicas and (
+            now - self._last_refresh < _REFRESH_PERIOD_S
+        ):
+            return
+        import ray_tpu
+
+        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        names = ray_tpu.get(
+            controller.get_replica_names.remote(
+                self.app_name, self.deployment_name
+            ),
+            timeout=30,
+        )
+        replicas = []
+        for n in names:
+            try:
+                replicas.append((n, ray_tpu.get_actor(n)))
+            except Exception:
+                pass
+        self._replicas = replicas
+        self._inflight = {n: self._inflight.get(n, 0) for n, _ in replicas}
+        self._last_refresh = now
+
+    def _pick(self):
+        """Power-of-two-choices on local in-flight counts."""
+        if not self._replicas:
+            raise RuntimeError(
+                f"no replicas for {self.app_name}/{self.deployment_name}"
+            )
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) \
+            else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        deadline = time.monotonic() + 30.0
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                self._refresh()
+                name, actor = self._pick()
+            except Exception as e:  # controller not up yet / no replicas
+                last_err = e
+                time.sleep(0.1)
+                continue
+            try:
+                ref = actor.handle_request.remote(self._method, args, kwargs)
+                self._inflight[name] = self._inflight.get(name, 0) + 1
+
+                def settle(n=name):
+                    self._inflight[n] = max(0, self._inflight.get(n, 1) - 1)
+
+                return DeploymentResponse(ref, on_settle=settle)
+            except Exception as e:
+                last_err = e
+                self._refresh(force=True)
+        raise RuntimeError(
+            f"could not route request to {self.deployment_name}: {last_err}"
+        )

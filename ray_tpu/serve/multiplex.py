@@ -1,0 +1,63 @@
+"""@serve.multiplexed — many models per replica with LRU load/unload.
+
+Reference parity: ray python/ray/serve/multiplex.py — decorate an async
+model loader; calls carry a model id; loaded models are cached per replica
+up to ``max_num_models_per_replica`` with least-recently-used eviction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import functools
+from typing import Callable, Optional
+
+_current_model_id: str = ""
+
+
+def get_multiplexed_model_id() -> str:
+    """ray parity: serve.get_multiplexed_model_id — inside a request,
+    the model id this call was routed with."""
+    return _current_model_id
+
+
+def multiplexed(_func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    def decorate(loader):
+        caches = {}
+
+        @functools.wraps(loader)
+        async def wrapper(*args):
+            global _current_model_id
+
+            if len(args) == 2:
+                inst, model_id = args
+                call = functools.partial(loader, inst)
+                key = id(inst)
+            else:
+                (model_id,) = args
+                call = loader
+                key = None
+            cache = caches.get(key)
+            if cache is None:
+                cache = collections.OrderedDict()
+                caches[key] = cache
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                _current_model_id = model_id
+                return cache[model_id]
+            model = call(model_id)
+            if asyncio.iscoroutine(model):
+                model = await model
+            cache[model_id] = model
+            cache.move_to_end(model_id)
+            while len(cache) > max_num_models_per_replica:
+                cache.popitem(last=False)
+            _current_model_id = model_id
+            return model
+
+        return wrapper
+
+    if _func is not None:
+        return decorate(_func)
+    return decorate

@@ -1,0 +1,145 @@
+"""HTTP ingress proxy.
+
+Reference parity: ray python/ray/serve/_private/http_proxy.py:888
+(HTTPProxyActor, ASGI/uvicorn) — here an aiohttp server inside an actor:
+requests are matched to the longest route prefix from the controller's
+routing table and forwarded to the app's ingress deployment handle; dict/
+list/str results render as JSON/text, bytes pass through.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.serve._common import Request
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._actual_port: Optional[int] = None
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_fetched_at = 0.0
+        self._handles = {}
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def ready(self) -> int:
+        self._ready.wait(timeout=30)
+        assert self._actual_port is not None, "proxy failed to bind"
+        return self._actual_port
+
+    # ------------------------------------------------------------------
+    def _serve(self):
+        asyncio.run(self._serve_async())
+
+    async def _serve_async(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = self._port
+        site = None
+        for attempt in range(20):
+            try:
+                site = web.TCPSite(runner, self._host, port)
+                await site.start()
+                break
+            except OSError:
+                port += 1
+                site = None
+        assert site is not None, "no free port for serve proxy"
+        self._actual_port = port
+        self._ready.set()
+        while True:
+            await asyncio.sleep(3600)
+
+    # ------------------------------------------------------------------
+    async def _refresh_routes(self, force: bool = False):
+        import time
+
+        import ray_tpu
+
+        # 1s TTL cache: a controller round-trip per request would put the
+        # single controller actor on the hot path
+        if not force and time.monotonic() - self._routes_fetched_at < 1.0:
+            return
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+            return ray_tpu.get(controller.get_routes.remote(), timeout=10)
+
+        self._routes = await loop.run_in_executor(None, fetch)
+        self._routes_fetched_at = time.monotonic()
+
+    def _match(self, path: str):
+        best = None
+        for prefix, target in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                norm + ("" if norm == "/" else "/")
+            ) or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, target)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        await self._refresh_routes()
+        m = self._match(request.path)
+        if m is None:
+            # maybe just deployed: force one refresh before 404ing
+            await self._refresh_routes(force=True)
+            m = self._match(request.path)
+        if m is None:
+            return web.Response(status=404, text="no app at this route")
+        _prefix, (app_name, ingress) = m
+        body = await request.read()
+        env = Request(
+            method=request.method,
+            path=request.path,
+            query=dict(request.query),
+            headers=dict(request.headers),
+            body=body,
+        )
+        key = (app_name, ingress)
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(ingress, app_name)
+            self._handles[key] = handle
+        loop = asyncio.get_running_loop()
+
+        def call():
+            # a replica can die between routing and execution (rolling
+            # update, crash) — retry on a freshly-refreshed replica set
+            last = None
+            for _attempt in range(3):
+                try:
+                    return handle.remote(env).result(timeout_s=60)
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    if "ActorDied" not in str(type(e).__name__) + str(e):
+                        raise
+                    handle._refresh(force=True)
+            raise last
+
+        try:
+            result = await loop.run_in_executor(None, call)
+        except Exception as e:  # noqa: BLE001 — surface as 500
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result, dumps=lambda o: json.dumps(o, default=str))

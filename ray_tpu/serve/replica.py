@@ -1,0 +1,95 @@
+"""Replica actor: hosts one instance of a deployment's user class/function.
+
+Reference parity: ray python/ray/serve/_private/replica.py:447
+(RayServeReplica) — the replica counts ongoing requests (the router and
+autoscaler read this), supports reconfigure(user_config), health checks,
+and graceful drain on shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(self, serialized_init: bytes, deployment: str, app: str,
+                 user_config: Optional[Any] = None,
+                 max_ongoing_requests: int = 100):
+        import cloudpickle
+        import concurrent.futures
+
+        cls_or_fn, init_args, init_kwargs = cloudpickle.loads(serialized_init)
+        self._deployment = deployment
+        self._app = app
+        self._ongoing = 0
+        self._total = 0
+        # sync user callables run here so concurrent requests don't
+        # serialize on the actor's event loop
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(max_ongoing_requests, 32),
+            thread_name_prefix="serve-replica",
+        )
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- control plane --------------------------------------------------
+    def reconfigure(self, user_config: Any):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def get_metrics(self) -> Dict[str, float]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def prepare_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain: wait for ongoing requests to finish."""
+        deadline = time.time() + timeout_s
+        while self._ongoing > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        return True
+
+    # -- data plane -----------------------------------------------------
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name in ("__call__", None):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            if inspect.iscoroutinefunction(target) or (
+                not self._is_function
+                and method_name in ("__call__", None)
+                and inspect.iscoroutinefunction(
+                    getattr(self._callable, "__call__", None)
+                )
+            ):
+                return await target(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(
+                self._pool, lambda: target(*args, **kwargs)
+            )
+            if inspect.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1

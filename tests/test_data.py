@@ -263,3 +263,26 @@ def test_tensor_columns_preserve_shape(ray_start_regular):
     assert batch["img"].shape == (8, 4, 4)
     t = data.range_tensor(6, shape=(2, 3))
     assert t.take_batch(6)["data"].shape == (6, 2, 3)
+
+
+def test_groupby_string_keys_across_processes(ray_start_regular):
+    """Hash partitioning must be deterministic across worker processes
+    (regression: builtin hash() salting split string-key groups)."""
+    d = data.from_items(
+        [{"k": f"key-{i % 3}", "v": i} for i in range(30)], parallelism=3
+    )
+    rows = d.groupby("k").count().take_all()
+    assert len(rows) == 3, rows
+    assert {r["count()"] for r in rows} == {10}, rows
+
+
+def test_streaming_split_abandoned_epoch(ray_start_regular):
+    """Breaking out of an epoch early must not deadlock the next epoch
+    (regression: leftover items blocked the epoch barrier)."""
+    its = data.range(12, parallelism=4).streaming_split(1)
+    it = iter(its[0]._source)
+    next(it)  # consume one block, abandon the rest
+    rows = []
+    for b in its[0].iter_batches(batch_size=None):  # epoch 2
+        rows.extend(np.asarray(b).tolist())
+    assert sorted(rows) == list(range(12)), rows
