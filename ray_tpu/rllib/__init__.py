@@ -1,0 +1,49 @@
+"""ray_tpu.rllib — reinforcement learning (ray parity: rllib/)."""
+
+from ray_tpu.rllib.algorithm import (
+    DQN,
+    DQNConfig,
+    IMPALA,
+    IMPALAConfig,
+    PPO,
+    PPOConfig,
+    Algorithm,
+    AlgorithmConfig,
+)
+from ray_tpu.rllib.env import CartPole, make_env, register_env
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import (
+    DQNLearner,
+    ImpalaLearner,
+    Learner,
+    PPOLearner,
+    vtrace,
+)
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPole",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
+    "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
+    "ImpalaLearner",
+    "Learner",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "PrioritizedReplayBuffer",
+    "RLModule",
+    "ReplayBuffer",
+    "SampleBatch",
+    "compute_gae",
+    "make_env",
+    "register_env",
+    "vtrace",
+]

@@ -1,0 +1,325 @@
+"""Algorithm + AlgorithmConfig: the RL training drivers.
+
+Reference parity: ray rllib/algorithms/algorithm.py:815 (Algorithm is a
+Tune Trainable; step() = training_step + metrics) and
+algorithm_config.py (fluent config). PPO's training_step mirrors
+rllib/algorithms/ppo/ppo.py:424 (synchronous_parallel_sample →
+learner update → weight broadcast); IMPALA applies v-trace to
+behavior-policy fragments; DQN replays from a (prioritized) buffer.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Dict, List, Optional, Type
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import env_spaces, make_env
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import (
+    DQNLearner,
+    ImpalaLearner,
+    Learner,
+    PPOLearner,
+)
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config (ray parity: AlgorithmConfig.environment()
+    .env_runners().training().resources())."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env = "CartPole-native"
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 2
+        self.rollout_fragment_length = 200
+        self.lr = 5e-3
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.train_batch_size = 0  # derived if 0
+        self.minibatch_size = 128
+        self.num_epochs = 6
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.grad_clip = 0.5
+        self.model: Dict[str, Any] = {"hiddens": (64, 64)}
+        self.seed = 0
+        # DQN
+        self.replay_buffer_capacity = 50_000
+        self.target_network_update_freq = 500
+        self.epsilon = (1.0, 0.05, 10_000)  # start, end, decay steps
+        self.num_steps_sampled_before_learning = 1_000
+
+    # -- fluent setters -------------------------------------------------
+    def environment(self, env=None, *, env_config=None, **_kw):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    rollout_fragment_length=None, **_kw):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    # accepted for reference-API compatibility
+    rollouts = env_runners
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            key = {"lambda": "lambda_"}.get(k, k)
+            if not hasattr(self, key):
+                continue
+            setattr(self, key, v)
+        return self
+
+    def framework(self, *_a, **_k):
+        return self  # always JAX here
+
+    def resources(self, **_k):
+        return self
+
+    def debugging(self, *, seed=None, **_k):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            k: v for k, v in vars(self).items() if k != "algo_class"
+        }
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self.env = env
+        cls = self.algo_class or Algorithm
+        return cls(config=self)
+
+    # Trainable-style usage through Tune
+    def build_algo(self, env=None):
+        return self.build(env)
+
+
+class Algorithm(Trainable):
+    """Trainable subclass so Tuner(PPO, param_space=...) works."""
+
+    _config_cls = AlgorithmConfig
+    _learner_cls: Type[Learner] = PPOLearner
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None,
+                 env=None, trial_info=None, **kw):
+        if isinstance(config, dict):
+            cfg = self._config_cls(type(self))
+            for k, v in config.items():
+                key = {"lambda": "lambda_"}.get(k, k)
+                if hasattr(cfg, key):
+                    setattr(cfg, key, v)
+            config = cfg
+        self._algo_config = config or self._config_cls(type(self))
+        if env is not None:
+            self._algo_config.env = env
+        super().__init__(self._algo_config.to_dict(), trial_info)
+        # Trainable.__init__ set self.config to the plain dict; the typed
+        # config is the API surface (ray parity: Algorithm.config)
+        self.config = self._algo_config
+
+    # -- Trainable plumbing --------------------------------------------
+    def setup(self, _config: Dict):
+        cfg = self._algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        obs_shape, num_actions = env_spaces(probe)
+        if hasattr(probe, "close"):
+            probe.close()
+        self.module = RLModule(
+            obs_shape, num_actions, seed=cfg.seed,
+            hiddens=tuple(cfg.model.get("hiddens", (64, 64))),
+        )
+        self.learner = self._learner_cls(self.module, cfg)
+        # Sampling plane runs on host CPUs: the learner owns the TPU chips
+        # (libtpu is single-client per host), so runner processes pin JAX
+        # to the CPU backend.
+        runner_cls = ray_tpu.remote(
+            num_cpus=0.5,
+            runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
+        )(EnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                cfg.env, cfg.env_config,
+                {"hiddens": tuple(cfg.model.get("hiddens", (64, 64)))},
+                seed=cfg.seed + i,
+            )
+            for i in range(cfg.num_env_runners)
+        ]
+        self._timesteps = 0
+
+    def step(self) -> Dict:
+        metrics = self.training_step()
+        metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.runners]
+        )
+        returns = [
+            m["episode_return_mean"]
+            for m in runner_metrics
+            if m.get("episodes_this_iter")
+        ]
+        if returns:
+            metrics["episode_return_mean"] = float(np.mean(returns))
+            # legacy metric name used across reference tooling
+            metrics["episode_reward_mean"] = metrics["episode_return_mean"]
+        return metrics
+
+    def training_step(self) -> Dict:
+        raise NotImplementedError
+
+    # -- utils ----------------------------------------------------------
+    def _sync_weights(self):
+        weights = ray_tpu.put(self.learner.get_weights())
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+
+    def _sample_all(self) -> List[SampleBatch]:
+        cfg = self.config
+        return ray_tpu.get(
+            [
+                r.sample.remote(cfg.rollout_fragment_length)
+                for r in self.runners
+            ]
+        )
+
+    def compute_single_action(self, obs, explore: bool = False):
+        obs = np.asarray(obs, np.float32)[None, :]
+        if explore:
+            import jax
+
+            a, _, _ = self.module.action_exploration(
+                obs, jax.random.PRNGKey(int(time.time() * 1e6) % 2**31)
+            )
+            return int(a[0])
+        return int(self.module.action_greedy(obs)[0])
+
+    def get_policy_state(self):
+        return self.learner.get_weights()
+
+    def save_checkpoint(self, checkpoint_dir=None) -> Dict:
+        return {"weights": self.learner.get_weights(),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, checkpoint: Optional[Dict]):
+        if checkpoint:
+            self.learner.set_weights(checkpoint["weights"])
+            self.module.set_state(checkpoint["weights"])
+            self._timesteps = checkpoint.get("timesteps", 0)
+            self._sync_weights()
+
+    def cleanup(self):
+        for r in getattr(self, "runners", []):
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def stop(self):
+        super().stop()
+
+    def evaluate(self) -> Dict:
+        score = ray_tpu.get(self.runners[0].evaluate.remote(5), timeout=300)
+        return {"evaluation": {"episode_return_mean": score}}
+
+
+class PPO(Algorithm):
+    _learner_cls = PPOLearner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        fragments = self._sample_all()
+        processed = []
+        for frag in fragments:
+            processed.append(
+                compute_gae(
+                    frag, float(frag["bootstrap_value"][-1]),
+                    cfg.gamma, cfg.lambda_,
+                )
+            )
+        batch = SampleBatch.concat(processed)
+        self._timesteps += batch.count
+        return self.learner.update(batch)
+
+
+class IMPALA(Algorithm):
+    _learner_cls = ImpalaLearner
+
+    def training_step(self) -> Dict:
+        self._sync_weights()
+        fragments = self._sample_all()
+        metrics = {}
+        for frag in fragments:  # per-fragment v-trace (time ordering)
+            self._timesteps += frag.count
+            metrics = self.learner.update(frag)
+        return metrics
+
+
+class DQN(Algorithm):
+    _learner_cls = DQNLearner
+
+    def setup(self, config):
+        super().setup(config)
+        self.buffer = ReplayBuffer(self._algo_config.replay_buffer_capacity,
+                                   seed=self._algo_config.seed)
+        self._since_target_sync = 0
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        for frag in self._sample_all():
+            self._timesteps += frag.count
+            self.buffer.add(frag)
+        if len(self.buffer) < cfg.num_steps_sampled_before_learning:
+            return {"buffer_size": len(self.buffer)}
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            batch = self.buffer.sample(cfg.minibatch_size)
+            metrics = self.learner.update(batch)
+            self._since_target_sync += 1
+            if self._since_target_sync >= max(
+                1, cfg.target_network_update_freq // cfg.minibatch_size
+            ):
+                self.learner.sync_target()
+                self._since_target_sync = 0
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PPO)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(IMPALA)
+        self.lr = 1e-3
+        self.entropy_coeff = 0.01
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.lr = 1e-3

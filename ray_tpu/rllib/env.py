@@ -1,0 +1,97 @@
+"""Environments: gymnasium adapter + dependency-free built-ins.
+
+Reference parity: ray rllib/env/ (BaseEnv/vector envs, env registry) —
+reduced to the single-agent gymnasium API (reset/step with terminated/
+truncated) plus a tiny registry so algorithm configs can name envs.
+CartPole is implemented natively as the learning-regression workhorse
+(ray parity: rllib/tuned_examples use CartPole-v1 everywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_env(name: str, creator: Callable[..., Any]):
+    """ray parity: ray.tune.register_env."""
+    _REGISTRY[name] = creator
+
+
+def make_env(spec: Any, env_config: Optional[dict] = None):
+    if not isinstance(spec, str):
+        return spec(env_config or {}) if callable(spec) else spec
+    if spec in _REGISTRY:
+        return _REGISTRY[spec](env_config or {})
+    try:
+        import gymnasium as gym
+
+        return gym.make(spec)
+    except Exception:
+        raise ValueError(f"unknown env {spec!r}") from None
+
+
+class CartPole:
+    """Classic cart-pole, gymnasium API, numpy only
+    (dynamics follow the standard formulation)."""
+
+    def __init__(self, env_config: Optional[dict] = None):
+        cfg = env_config or {}
+        self.max_steps = cfg.get("max_episode_steps", 500)
+        self.rng = np.random.default_rng(cfg.get("seed"))
+        self.observation_shape = (4,)
+        self.num_actions = 2
+        self._state = None
+        self._t = 0
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self._state = self.rng.uniform(-0.05, 0.05, size=4).astype(np.float32)
+        self._t = 0
+        return self._state.copy(), {}
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        g, mc, mp, length = 9.8, 1.0, 0.1, 0.5
+        total = mc + mp
+        pml = mp * length
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + pml * th_dot**2 * sinth) / total
+        th_acc = (g * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - mp * costh**2 / total)
+        )
+        x_acc = temp - pml * th_acc * costh / total
+        tau = 0.02
+        x += tau * x_dot
+        x_dot += tau * x_acc
+        th += tau * th_dot
+        th_dot += tau * th_acc
+        self._state = np.array([x, x_dot, th, th_dot], dtype=np.float32)
+        self._t += 1
+        terminated = bool(
+            abs(x) > 2.4 or abs(th) > 12 * np.pi / 180
+        )
+        truncated = self._t >= self.max_steps
+        return self._state.copy(), 1.0, terminated, truncated, {}
+
+    def close(self):
+        pass
+
+
+register_env("CartPole-native", lambda cfg: CartPole(cfg))
+
+
+def env_spaces(env) -> Tuple[tuple, int]:
+    """(observation_shape, num_discrete_actions) for built-in or gym envs."""
+    if hasattr(env, "observation_shape"):
+        return tuple(env.observation_shape), int(env.num_actions)
+    obs_space = env.observation_space
+    act_space = env.action_space
+    shape = tuple(obs_space.shape)
+    n = int(act_space.n)
+    return shape, n

@@ -1,0 +1,121 @@
+"""EnvRunner: the sampling-plane actor.
+
+Reference parity: ray rllib/evaluation/rollout_worker.py:660 (sample) /
+rllib/env/env_runner.py — an actor stepping one env with the current
+policy, returning fixed-size rollout fragments with log-probs and value
+estimates attached (what PPO/IMPALA need), plus episode-return metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import env_spaces, make_env
+from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class EnvRunner:
+    def __init__(self, env_spec: Any, env_config: Optional[dict],
+                 module_kwargs: Dict, seed: int = 0):
+        import jax
+
+        self.env = make_env(env_spec, env_config)
+        obs_shape, num_actions = env_spaces(self.env)
+        self.module = RLModule(obs_shape, num_actions, seed=seed,
+                               **module_kwargs)
+        self._key = jax.random.PRNGKey(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self._completed: list = []
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+        return True
+
+    def get_weights(self):
+        return self.module.get_state()
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        import jax
+
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = (
+            [], [], [], [], [], []
+        )
+        next_obs_buf = []
+        for _ in range(num_steps):
+            self._key, sub = jax.random.split(self._key)
+            a, logp, v = self.module.action_exploration(
+                self._obs[None, :], sub
+            )
+            action = int(a[0])
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            obs_buf.append(self._obs)
+            next_obs_buf.append(nxt)
+            act_buf.append(action)
+            rew_buf.append(reward)
+            # bootstrap through time-limit truncation, not termination
+            done_buf.append(terminated)
+            logp_buf.append(logp[0])
+            val_buf.append(v[0])
+            self._episode_return += reward
+            self._episode_len += 1
+            if terminated or truncated:
+                self._completed.append(
+                    {"return": self._episode_return, "len": self._episode_len}
+                )
+                self._episode_return = 0.0
+                self._episode_len = 0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        batch = SampleBatch(
+            {
+                sb.OBS: np.asarray(obs_buf, np.float32),
+                sb.NEXT_OBS: np.asarray(next_obs_buf, np.float32),
+                sb.ACTIONS: np.asarray(act_buf, np.int32),
+                sb.REWARDS: np.asarray(rew_buf, np.float32),
+                sb.DONES: np.asarray(done_buf, np.bool_),
+                sb.LOGP: np.asarray(logp_buf, np.float32),
+                sb.VALUES: np.asarray(val_buf, np.float32),
+            }
+        )
+        # bootstrap value for the final (possibly mid-episode) state
+        _, _, v = self.module.action_exploration(
+            self._obs[None, :], jax.random.PRNGKey(0)
+        )
+        batch["bootstrap_value"] = np.full(
+            batch.count, float(v[0]), np.float32
+        )
+        return batch
+
+    def get_metrics(self) -> Dict[str, float]:
+        eps, self._completed = self._completed, []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        returns = [e["return"] for e in eps]
+        return {
+            "episodes_this_iter": len(eps),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean([e["len"] for e in eps])),
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Greedy policy evaluation, returns mean episode return."""
+        total = []
+        for _ in range(num_episodes):
+            obs, _ = self.env.reset()
+            ep_ret, done = 0.0, False
+            while not done:
+                a = self.module.action_greedy(obs[None, :])
+                obs, r, term, trunc, _ = self.env.step(int(a[0]))
+                ep_ret += r
+                done = term or trunc
+            total.append(ep_ret)
+        return float(np.mean(total))

@@ -1,0 +1,71 @@
+"""Replay buffers (ray parity: rllib/utils/replay_buffers/
+replay_buffer.py:67 + prioritized_replay_buffer.py:19)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 100_000, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._storage: dict = {}
+        self._size = 0
+        self._next = 0
+        self.rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._size
+
+    def add(self, batch: SampleBatch):
+        n = batch.count
+        if not self._storage:
+            self._storage = {
+                k: np.zeros((self.capacity, *v.shape[1:]), v.dtype)
+                for k, v in batch.items()
+            }
+        for i in range(n):
+            for k, v in batch.items():
+                self._storage[k][self._next] = v[i]
+            self._next = (self._next + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, num_items: int) -> SampleBatch:
+        idx = self.rng.integers(0, self._size, size=num_items)
+        return SampleBatch({k: v[idx] for k, v in self._storage.items()})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: Optional[int] = None):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add(self, batch: SampleBatch):
+        n = batch.count
+        start = self._next
+        super().add(batch)
+        for i in range(n):
+            self._prio[(start + i) % self.capacity] = self._max_prio
+
+    def sample(self, num_items: int) -> SampleBatch:
+        p = self._prio[: self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self.rng.choice(self._size, size=num_items, p=p)
+        weights = (self._size * p[idx]) ** (-self.beta)
+        weights = weights / weights.max()
+        out = SampleBatch({k: v[idx] for k, v in self._storage.items()})
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray):
+        self._prio[idx] = priorities + 1e-6
+        self._max_prio = max(self._max_prio, float(priorities.max()))

@@ -1,0 +1,79 @@
+"""RLModule: the policy/value network abstraction.
+
+Reference parity: ray rllib/core/rl_module/rl_module.py — TPU-native in
+flax: pure-functional forward passes that jit cleanly on both the sampling
+path (CPU env-runners) and the XLA learner path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DiscreteActorCritic(nn.Module):
+    """MLP torso with policy-logits + value heads (ray parity: the default
+    fcnet Catalog model)."""
+
+    num_actions: int
+    hiddens: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.tanh(nn.Dense(h, name=f"fc_{i}")(x))
+        logits = nn.Dense(self.num_actions, name="pi")(x)
+        value = nn.Dense(1, name="vf")(x)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Bundles a flax module + param pytree with jitted inference ops."""
+
+    def __init__(self, obs_shape: tuple, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64), seed: int = 0):
+        self.net = DiscreteActorCritic(num_actions, tuple(hiddens))
+        self.obs_shape = obs_shape
+        self.num_actions = num_actions
+        dummy = jnp.zeros((1, *obs_shape), jnp.float32)
+        self.params = self.net.init(jax.random.PRNGKey(seed), dummy)["params"]
+
+        def fwd(params, obs):
+            return self.net.apply({"params": params}, obs)
+
+        self.forward = jax.jit(fwd)
+
+        def explore(params, obs, key):
+            logits, value = fwd(params, obs)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), action
+            ]
+            return action, logp, value
+
+        self._explore = jax.jit(explore)
+
+        def greedy(params, obs):
+            logits, _ = fwd(params, obs)
+            return jnp.argmax(logits, axis=-1)
+
+        self._greedy = jax.jit(greedy)
+
+    # -- inference entry points ----------------------------------------
+    def action_exploration(self, obs: np.ndarray, key):
+        a, logp, v = self._explore(self.params, obs, key)
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def action_greedy(self, obs: np.ndarray):
+        return np.asarray(self._greedy(self.params, obs))
+
+    def get_state(self) -> Dict[str, Any]:
+        return jax.device_get(self.params)
+
+    def set_state(self, params):
+        self.params = jax.device_put(params)

@@ -1,0 +1,71 @@
+"""SampleBatch + advantage estimation.
+
+Reference parity: ray rllib/policy/sample_batch.py:98 (SampleBatch) and
+rllib/evaluation/postprocessing.py (GAE) — a dict of parallel numpy
+arrays with concat/shuffle/minibatch helpers; GAE/v-trace run as jitted
+JAX transforms in the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "next_obs"
+LOGP = "logp"
+VALUES = "values"
+ADVANTAGES = "advantages"
+TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"]) -> "SampleBatch":
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([b[k] for b in batches]) for k in keys}
+        )
+
+    def shuffled(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(self.count)
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n, size):
+            yield SampleBatch(
+                {k: v[start : start + size] for k, v in self.items()}
+            )
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
+                lam: float) -> SampleBatch:
+    """Generalized advantage estimation over one rollout fragment
+    (ray parity: postprocessing.compute_advantages)."""
+    rewards = batch[REWARDS]
+    values = batch[VALUES]
+    dones = batch[DONES]
+    n = len(rewards)
+    adv = np.zeros(n, np.float32)
+    last_gae = 0.0
+    next_value = last_value
+    for t in reversed(range(n)):
+        nonterminal = 1.0 - float(dones[t])
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    batch[ADVANTAGES] = adv
+    batch[TARGETS] = adv + values
+    return batch

@@ -66,25 +66,23 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
     def decorate(fn):
         queues = {}  # per (instance or None)
 
-        if asyncio.iscoroutinefunction(fn) or True:
-            @functools.wraps(fn)
-            async def wrapper(*args):
-                if len(args) == 2:  # bound method: (self, item)
-                    inst, item = args
-                    call = functools.partial(fn, inst)
-                    key = id(inst)
-                else:
-                    (item,) = args
-                    call = fn
-                    key = None
-                q = queues.get(key)
-                if q is None:
-                    q = _BatchQueue(call, max_batch_size,
-                                    batch_wait_timeout_s)
-                    queues[key] = q
-                return await q.submit(item)
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                inst, item = args
+                call = functools.partial(fn, inst)
+                key = id(inst)
+            else:
+                (item,) = args
+                call = fn
+                key = None
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(call, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return await q.submit(item)
 
-            return wrapper
+        return wrapper
 
     if _func is not None:
         return decorate(_func)

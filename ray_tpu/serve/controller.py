@@ -113,10 +113,13 @@ class ServeController:
         while time.time() < deadline:
             with self._lock:
                 app = self._apps.get(app_name)
-                if app is not None and all(
-                    len(st.replicas) >= st.target for st in app.values()
-                ):
-                    return True
+                if app is not None:
+                    if any(st.broken for st in app.values()):
+                        return False  # fail fast: constructor keeps raising
+                    if all(
+                        len(st.replicas) >= st.target for st in app.values()
+                    ):
+                        return True
             time.sleep(0.05)
         return False
 
@@ -224,6 +227,16 @@ class ServeController:
                     break
                 st.consecutive_start_failures = 0
                 with self._lock:
+                    # the app may have been deleted/redeployed while we
+                    # blocked on the health check: registering on a stale
+                    # state would leak a live named replica actor
+                    current = (self._apps.get(st.app) or {}).get(st.name)
+                    if current is not st:
+                        try:
+                            ray_tpu.kill(handle)
+                        except Exception:
+                            pass
+                        break
                     st.replicas[name] = handle
             # rolling update: drain old-version replicas once at target
             if st.draining and len(st.replicas) >= st.target:
@@ -237,18 +250,22 @@ class ServeController:
                     name, handle = next(iter(st.replicas.items()))
                     del st.replicas[name]
                 self._graceful_stop(st, handle)
-            # health check
-            for name, handle in list(st.replicas.items()):
-                try:
-                    ray_tpu.get(handle.check_health.remote(), timeout=30)
-                except Exception:
-                    logger.warning("replica %s unhealthy; replacing", name)
-                    with self._lock:
-                        st.replicas.pop(name, None)
+            # health check, on the configured period (not every loop pass)
+            now = time.time()
+            if now - getattr(st, "_last_health_check", 0.0) >= \
+                    st.config.health_check_period_s:
+                st._last_health_check = now
+                for name, handle in list(st.replicas.items()):
                     try:
-                        ray_tpu.kill(handle)
+                        ray_tpu.get(handle.check_health.remote(), timeout=30)
                     except Exception:
-                        pass
+                        logger.warning("replica %s unhealthy; replacing", name)
+                        with self._lock:
+                            st.replicas.pop(name, None)
+                        try:
+                            ray_tpu.kill(handle)
+                        except Exception:
+                            pass
 
     def _autoscale_once(self):
         import ray_tpu

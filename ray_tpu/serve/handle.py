@@ -33,6 +33,14 @@ class DeploymentResponse:
             if self._on_settle:
                 self._on_settle()
 
+    def __del__(self):
+        # fire-and-forget callers never resolve the response; releasing on
+        # GC keeps the router's in-flight load scores honest
+        try:
+            self._settle()
+        except Exception:
+            pass
+
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
 

@@ -19,12 +19,19 @@ from ray_tpu.serve._common import Request
 
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        import concurrent.futures
+
         self._host = host
         self._port = port
         self._actual_port: Optional[int] = None
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._routes_fetched_at = 0.0
         self._handles = {}
+        # dedicated pool: the default asyncio executor is ~32 threads, and
+        # every in-flight request blocks one for up to its full timeout
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=256, thread_name_prefix="serve-proxy"
+        )
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
@@ -77,7 +84,7 @@ class HTTPProxy:
             controller = ray_tpu.get_actor("SERVE_CONTROLLER")
             return ray_tpu.get(controller.get_routes.remote(), timeout=10)
 
-        self._routes = await loop.run_in_executor(None, fetch)
+        self._routes = await loop.run_in_executor(self._pool, fetch)
         self._routes_fetched_at = time.monotonic()
 
     def _match(self, path: str):
@@ -135,7 +142,7 @@ class HTTPProxy:
             raise last
 
         try:
-            result = await loop.run_in_executor(None, call)
+            result = await loop.run_in_executor(self._pool, call)
         except Exception as e:  # noqa: BLE001 — surface as 500
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         if isinstance(result, bytes):

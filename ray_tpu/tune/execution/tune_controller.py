@@ -36,7 +36,9 @@ class _TrialActor:
     """The per-trial actor: hosts one Trainable instance."""
 
     def __init__(self, trainable_cls, config, trial_info):
-        self._t: Trainable = trainable_cls(config, trial_info)
+        # keyword: subclasses (e.g. rllib Algorithm) put extra positional
+        # params between config and trial_info, mirroring the reference
+        self._t: Trainable = trainable_cls(config, trial_info=trial_info)
 
     def train(self):
         return self._t.train()
