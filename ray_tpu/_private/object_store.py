@@ -102,6 +102,12 @@ def write_object(
     final = _obj_path(store_dir, object_id)
     if os.path.exists(final):
         return 0
+    from ray_tpu._private import native_store
+
+    if native_store.available():
+        return native_store.write_object(
+            store_dir, object_id.hex(), metadata, buffers, total_data_len
+        )
     tmp = final + f".building.{os.getpid()}"
     size = _HEADER + len(metadata) + total_data_len
     with open(tmp, "wb") as f:
@@ -113,6 +119,17 @@ def write_object(
             f.write(buf)
     os.rename(tmp, final)
     return size
+
+
+def make_local_store(store_dir: str, capacity_bytes: int):
+    """Owner-side store factory: native C++ store (src/librtpu_store.so)
+    when loadable, else the pure-Python implementation. Both share the
+    same on-disk format, so mixed clusters interoperate."""
+    from ray_tpu._private import native_store
+
+    if native_store.available():
+        return native_store.NativeLocalObjectStore(store_dir, capacity_bytes)
+    return LocalObjectStore(store_dir, capacity_bytes)
 
 
 class LocalObjectStore:

@@ -97,7 +97,7 @@ class Raylet:
         self.host = host
         self.server = RpcServer(self, host, port)
         self.store_dir = os.path.join(session_dir, f"store_{self.node_id[:12]}")
-        self.store = object_store.LocalObjectStore(self.store_dir, cfg.object_store_memory)
+        self.store = object_store.make_local_store(self.store_dir, cfg.object_store_memory)
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.labels = labels or {}

@@ -1,0 +1,302 @@
+// Native shared-memory object store.
+//
+// C++ implementation of the node-local object store (plasma analog —
+// reference: ray src/ray/object_manager/plasma/{store.h,
+// object_lifecycle_manager.h:101, eviction_policy.h:160}).  Same on-disk
+// format as the Python fallback in ray_tpu/_private/object_store.py:
+//
+//   [8B magic "RTPUOBJ1"][8B metadata_len][8B data_len][metadata][data]
+//
+// sealed atomically via rename, so Python readers/writers and this native
+// store interoperate on the same directory.  Exposed as a C ABI for
+// ctypes (no pybind11 in this environment).
+//
+// Build: make -C src   ->  src/librtpu_store.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'R', 'T', 'P', 'U', 'O', 'B', 'J', '1'};
+constexpr uint64_t kHeader = 24;
+
+std::string ObjPath(const std::string& dir, const std::string& oid_hex) {
+  return dir + "/" + oid_hex + ".obj";
+}
+
+// One mapped, sealed object handed out to a reader.
+struct MappedObject {
+  void* base = nullptr;
+  uint64_t size = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// stateless object IO (any process)
+// ---------------------------------------------------------------------------
+
+// Create + seal an object from N buffers. Returns total file size on
+// success, 0 if the object already exists, -1 on error.
+long rtpu_write_object(const char* store_dir, const char* oid_hex,
+                       const uint8_t* metadata, uint64_t meta_len,
+                       const uint8_t* const* bufs, const uint64_t* buf_lens,
+                       uint64_t nbufs) {
+  const std::string final_path = ObjPath(store_dir, oid_hex);
+  struct stat st;
+  if (::stat(final_path.c_str(), &st) == 0) return 0;  // immutable: no-op
+
+  uint64_t data_len = 0;
+  for (uint64_t i = 0; i < nbufs; ++i) data_len += buf_lens[i];
+  const uint64_t total = kHeader + meta_len + data_len;
+
+  const std::string tmp =
+      final_path + ".building." + std::to_string(::getpid());
+  int fd = ::open(tmp.c_str(), O_CREAT | O_TRUNC | O_RDWR, 0644);
+  if (fd < 0) return -1;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::unlink(tmp.c_str());
+    return -1;
+  }
+  void* map = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    ::unlink(tmp.c_str());
+    return -1;
+  }
+  uint8_t* p = static_cast<uint8_t*>(map);
+  std::memcpy(p, kMagic, 8);
+  std::memcpy(p + 8, &meta_len, 8);
+  std::memcpy(p + 16, &data_len, 8);
+  std::memcpy(p + kHeader, metadata, meta_len);
+  uint8_t* cursor = p + kHeader + meta_len;
+  for (uint64_t i = 0; i < nbufs; ++i) {
+    std::memcpy(cursor, bufs[i], buf_lens[i]);
+    cursor += buf_lens[i];
+  }
+  ::munmap(map, total);
+  ::close(fd);
+  if (::rename(tmp.c_str(), final_path.c_str()) != 0) {
+    ::unlink(tmp.c_str());
+    return -1;
+  }
+  return static_cast<long>(total);
+}
+
+// Map a sealed object read-only. On success returns an opaque handle and
+// fills the out-pointers; returns nullptr if absent or corrupt.
+void* rtpu_open_object(const char* store_dir, const char* oid_hex,
+                       const uint8_t** meta_ptr, uint64_t* meta_len,
+                       const uint8_t** data_ptr, uint64_t* data_len) {
+  const std::string path = ObjPath(store_dir, oid_hex);
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < (off_t)kHeader) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = ::mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping keeps the inode alive
+  if (map == MAP_FAILED) return nullptr;
+  const uint8_t* p = static_cast<const uint8_t*>(map);
+  if (std::memcmp(p, kMagic, 8) != 0) {
+    ::munmap(map, st.st_size);
+    return nullptr;
+  }
+  uint64_t mlen, dlen;
+  std::memcpy(&mlen, p + 8, 8);
+  std::memcpy(&dlen, p + 16, 8);
+  if (kHeader + mlen + dlen > static_cast<uint64_t>(st.st_size)) {
+    ::munmap(map, st.st_size);
+    return nullptr;
+  }
+  *meta_ptr = p + kHeader;
+  *meta_len = mlen;
+  *data_ptr = p + kHeader + mlen;
+  *data_len = dlen;
+  auto* handle = new MappedObject{map, static_cast<uint64_t>(st.st_size)};
+  return handle;
+}
+
+void rtpu_release_object(void* handle) {
+  auto* h = static_cast<MappedObject*>(handle);
+  if (h == nullptr) return;
+  ::munmap(h->base, h->size);
+  delete h;
+}
+
+int rtpu_object_exists(const char* store_dir, const char* oid_hex) {
+  struct stat st;
+  return ::stat(ObjPath(store_dir, oid_hex).c_str(), &st) == 0 ? 1 : 0;
+}
+
+// ---------------------------------------------------------------------------
+// owner-side store: capacity accounting, pinning, LRU eviction
+// (one instance inside the raylet; reference: ObjectLifecycleManager)
+// ---------------------------------------------------------------------------
+
+struct RtpuStore {
+  std::string dir;
+  uint64_t capacity = 0;
+  uint64_t used = 0;
+  std::mutex mu;
+  // LRU list front = oldest; map value = (size, pin_count, lru iterator)
+  std::list<std::string> lru;
+  struct Entry {
+    uint64_t size;
+    int pins;
+    std::list<std::string>::iterator it;
+  };
+  std::unordered_map<std::string, Entry> objects;
+
+  void DeleteLocked(const std::string& oid) {
+    auto found = objects.find(oid);
+    if (found == objects.end()) return;
+    ::unlink(ObjPath(dir, oid).c_str());
+    used -= found->second.size;
+    lru.erase(found->second.it);
+    objects.erase(found);
+  }
+
+  // returns false if space cannot be made (everything pinned)
+  bool EnsureSpaceLocked(uint64_t size) {
+    if (used + size <= capacity) return true;
+    for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
+      const std::string oid = *it;
+      ++it;  // advance before possible erase
+      auto found = objects.find(oid);
+      if (found == objects.end() || found->second.pins > 0) continue;
+      DeleteLocked(oid);
+    }
+    return used + size <= capacity;
+  }
+
+  void TrackLocked(const std::string& oid, uint64_t size) {
+    auto found = objects.find(oid);
+    if (found != objects.end()) {
+      lru.splice(lru.end(), lru, found->second.it);
+      return;
+    }
+    lru.push_back(oid);
+    objects[oid] = Entry{size, 0, std::prev(lru.end())};
+    used += size;
+  }
+};
+
+void* rtpu_store_create(const char* dir, uint64_t capacity) {
+  ::mkdir(dir, 0755);
+  auto* s = new RtpuStore;
+  s->dir = dir;
+  s->capacity = capacity;
+  return s;
+}
+
+void rtpu_store_destroy(void* store) {
+  delete static_cast<RtpuStore*>(store);
+}
+
+// put = ensure space + write + account. Returns bytes written (0 if the
+// object existed), -1 on IO error, -2 if it cannot fit (store full).
+long rtpu_store_put(void* store, const char* oid_hex, const uint8_t* metadata,
+                    uint64_t meta_len, const uint8_t* const* bufs,
+                    const uint64_t* buf_lens, uint64_t nbufs) {
+  auto* s = static_cast<RtpuStore*>(store);
+  uint64_t data_len = 0;
+  for (uint64_t i = 0; i < nbufs; ++i) data_len += buf_lens[i];
+  const uint64_t total = kHeader + meta_len + data_len;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (!s->EnsureSpaceLocked(total)) return -2;
+  }
+  long written = rtpu_write_object(s->dir.c_str(), oid_hex, metadata,
+                                   meta_len, bufs, buf_lens, nbufs);
+  if (written > 0) {
+    std::lock_guard<std::mutex> lock(s->mu);
+    s->TrackLocked(oid_hex, static_cast<uint64_t>(written));
+  }
+  return written;
+}
+
+// Account for an object file written directly by a worker process.
+void rtpu_store_register_external(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  struct stat st;
+  if (::stat(ObjPath(s->dir, oid_hex).c_str(), &st) != 0) return;
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->TrackLocked(oid_hex, static_cast<uint64_t>(st.st_size));
+}
+
+void rtpu_store_touch(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto found = s->objects.find(oid_hex);
+  if (found != s->objects.end()) {
+    s->lru.splice(s->lru.end(), s->lru, found->second.it);
+  }
+}
+
+void rtpu_store_pin(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto found = s->objects.find(oid_hex);
+  if (found != s->objects.end()) found->second.pins += 1;
+}
+
+void rtpu_store_unpin(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto found = s->objects.find(oid_hex);
+  if (found != s->objects.end() && found->second.pins > 0) {
+    found->second.pins -= 1;
+  }
+}
+
+void rtpu_store_delete(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->DeleteLocked(oid_hex);
+}
+
+uint64_t rtpu_store_used(void* store) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->used;
+}
+
+uint64_t rtpu_store_count(void* store) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->objects.size();
+}
+
+// Fill up to cap entries of oid hex strings (65 bytes each incl NUL).
+// Returns number written.
+uint64_t rtpu_store_list(void* store, char* out, uint64_t cap) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  uint64_t n = 0;
+  for (const auto& kv : s->objects) {
+    if (n >= cap) break;
+    std::snprintf(out + n * 65, 65, "%s", kv.first.c_str());
+    ++n;
+  }
+  return n;
+}
+
+}  // extern "C"
