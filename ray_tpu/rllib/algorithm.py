@@ -219,11 +219,15 @@ class Algorithm(Trainable):
 
     def save_checkpoint(self, checkpoint_dir=None) -> Dict:
         return {"weights": self.learner.get_weights(),
+                "opt_state": self.learner.get_optimizer_state(),
                 "timesteps": self._timesteps}
 
     def load_checkpoint(self, checkpoint: Optional[Dict]):
         if checkpoint:
             self.learner.set_weights(checkpoint["weights"])
+            # restore Adam moments (None re-inits: a legacy checkpoint must
+            # not keep moments matched to the overwritten weights)
+            self.learner.set_optimizer_state(checkpoint.get("opt_state"))
             self.module.set_state(checkpoint["weights"])
             self._timesteps = checkpoint.get("timesteps", 0)
             self._sync_weights()

@@ -40,13 +40,21 @@ class EnvRunner:
     def get_weights(self):
         return self.module.get_state()
 
+    def _value_of(self, obs) -> float:
+        import jax
+
+        _, _, v = self.module.action_exploration(
+            np.asarray(obs, np.float32)[None, :], jax.random.PRNGKey(0)
+        )
+        return float(v[0])
+
     def sample(self, num_steps: int) -> SampleBatch:
         import jax
 
         obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = (
             [], [], [], [], [], []
         )
-        next_obs_buf = []
+        next_obs_buf, trunc_buf, vf_next_buf = [], [], []
         for _ in range(num_steps):
             self._key, sub = jax.random.split(self._key)
             a, logp, v = self.module.action_exploration(
@@ -60,8 +68,18 @@ class EnvRunner:
             rew_buf.append(reward)
             # bootstrap through time-limit truncation, not termination
             done_buf.append(terminated)
+            trunc_buf.append(bool(truncated) and not terminated)
             logp_buf.append(logp[0])
             val_buf.append(v[0])
+            if terminated:
+                vf_next_buf.append(0.0)  # unused: bootstrap is cut
+            elif truncated:
+                # V of the episode's final obs, captured BEFORE reset —
+                # GAE must bootstrap from the truncated state, not the
+                # new episode's reset obs.
+                vf_next_buf.append(self._value_of(nxt))
+            else:
+                vf_next_buf.append(np.nan)  # = values[t+1], filled below
             self._episode_return += reward
             self._episode_len += 1
             if terminated or truncated:
@@ -73,6 +91,15 @@ class EnvRunner:
                 self._obs, _ = self.env.reset()
             else:
                 self._obs = nxt
+        values = np.asarray(val_buf, np.float32)
+        vf_next = np.asarray(vf_next_buf, np.float32)
+        # Fill mid-episode steps with the next step's on-policy value; the
+        # fragment's last step (if mid-episode) bootstraps from the live obs.
+        if num_steps and np.isnan(vf_next[-1]):
+            vf_next[-1] = self._value_of(self._obs)
+        nan_mask = np.isnan(vf_next)
+        if nan_mask.any():
+            vf_next[nan_mask] = values[1:][nan_mask[:-1]]
         batch = SampleBatch(
             {
                 sb.OBS: np.asarray(obs_buf, np.float32),
@@ -80,16 +107,15 @@ class EnvRunner:
                 sb.ACTIONS: np.asarray(act_buf, np.int32),
                 sb.REWARDS: np.asarray(rew_buf, np.float32),
                 sb.DONES: np.asarray(done_buf, np.bool_),
+                sb.TRUNCATEDS: np.asarray(trunc_buf, np.bool_),
                 sb.LOGP: np.asarray(logp_buf, np.float32),
-                sb.VALUES: np.asarray(val_buf, np.float32),
+                sb.VALUES: values,
+                sb.VF_NEXT: vf_next,
             }
         )
-        # bootstrap value for the final (possibly mid-episode) state
-        _, _, v = self.module.action_exploration(
-            self._obs[None, :], jax.random.PRNGKey(0)
-        )
+        # fragment-end bootstrap (legacy consumers): == vf_next of last step
         batch["bootstrap_value"] = np.full(
-            batch.count, float(v[0]), np.float32
+            batch.count, float(vf_next[-1]) if num_steps else 0.0, np.float32
         )
         return batch
 

@@ -41,8 +41,20 @@ class Learner:
         return self.module.get_state()
 
     def set_weights(self, params):
+        # Weights-only update: Adam moments survive (checkpoint restore and
+        # Tune pause/resume must not silently cold-start the optimizer).
         self.module.set_state(params)
-        self.opt_state = self.tx.init(self.module.params)
+
+    def get_optimizer_state(self):
+        return self.opt_state
+
+    def set_optimizer_state(self, opt_state):
+        """Restore Adam moments; ``None`` re-inits (a checkpoint without
+        optimizer state must not keep moments from the discarded weights)."""
+        if opt_state is None:
+            self.opt_state = self.tx.init(self.module.params)
+        else:
+            self.opt_state = opt_state
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         raise NotImplementedError
@@ -104,28 +116,37 @@ class PPOLearner(Learner):
         return {k: float(v) for k, v in metrics.items()}
 
 
-def vtrace(behavior_logp, target_logp, rewards, values, bootstrap, dones,
-           gamma, clip_rho: float = 1.0, clip_c: float = 1.0):
-    """V-trace targets (IMPALA) over one fragment (time-major 1D arrays)."""
+def vtrace(behavior_logp, target_logp, rewards, values, next_values, dones,
+           truncateds, gamma, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace targets (IMPALA) over one fragment (time-major 1D arrays).
+
+    ``next_values`` is V(s_{t+1}) per step, with the pre-reset observation's
+    value at truncations (env_runner's VF_NEXT). Terminations cut the reward
+    bootstrap; truncations only cut the correction chain.
+    """
     rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_rho)
     c = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_c)
     nonterminal = 1.0 - dones.astype(jnp.float32)
-    next_values = jnp.concatenate([values[1:], bootstrap[None]])
+    chain = nonterminal * (1.0 - truncateds.astype(jnp.float32))
     deltas = rho * (rewards + gamma * next_values * nonterminal - values)
 
     def body(carry, xs):
         acc = carry
-        delta, c_t, nt = xs
-        acc = delta + gamma * c_t * nt * acc
+        delta, c_t, ch = xs
+        acc = delta + gamma * c_t * ch * acc
         return acc, acc
 
     _, advs_rev = jax.lax.scan(
         body, jnp.zeros(()),
-        (deltas[::-1], c[::-1], nonterminal[::-1]),
+        (deltas[::-1], c[::-1], chain[::-1]),
     )
     vs_minus_v = advs_rev[::-1]
     vs = values + vs_minus_v
-    next_vs = jnp.concatenate([vs[1:], bootstrap[None]])
+    # vs_{t+1} within an episode; across a truncation/fragment boundary the
+    # uncorrected next_values bootstrap is the only estimate available.
+    vs_tp1 = jnp.concatenate([vs[1:], next_values[-1:]])
+    boundary = (dones | truncateds.astype(dones.dtype)).astype(jnp.float32)
+    next_vs = boundary * next_values + (1.0 - boundary) * vs_tp1
     pg_adv = rho * (rewards + gamma * next_vs * nonterminal - values)
     return vs, pg_adv
 
@@ -147,7 +168,7 @@ class ImpalaLearner(Learner):
             vs, pg_adv = vtrace(
                 mb[sb.LOGP], jax.lax.stop_gradient(target_logp),
                 mb[sb.REWARDS], jax.lax.stop_gradient(values),
-                mb["bootstrap_value"][-1], mb[sb.DONES], gamma,
+                mb[sb.VF_NEXT], mb[sb.DONES], mb[sb.TRUNCATEDS], gamma,
             )
             pi_loss = -(jax.lax.stop_gradient(pg_adv) * target_logp).mean()
             vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()

@@ -15,10 +15,12 @@ import numpy as np
 OBS = "obs"
 ACTIONS = "actions"
 REWARDS = "rewards"
-DONES = "dones"
+DONES = "dones"  # terminated only: cuts the reward bootstrap
+TRUNCATEDS = "truncateds"  # time-limit cut: cuts the GAE chain, not bootstrap
 NEXT_OBS = "next_obs"
 LOGP = "logp"
 VALUES = "values"
+VF_NEXT = "vf_next"  # V(s_{t+1}) with the *pre-reset* obs at truncations
 ADVANTAGES = "advantages"
 TARGETS = "value_targets"
 
@@ -52,20 +54,32 @@ class SampleBatch(dict):
 def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
                 lam: float) -> SampleBatch:
     """Generalized advantage estimation over one rollout fragment
-    (ray parity: postprocessing.compute_advantages)."""
+    (ray parity: postprocessing.compute_advantages).
+
+    Truncation (time-limit) handling: the value bootstrap at a truncated
+    step uses V of the episode's *final* observation (``VF_NEXT``, captured
+    before the env reset), and the GAE chain is cut there — terminated
+    steps cut both the bootstrap and the chain.
+    """
     rewards = batch[REWARDS]
     values = batch[VALUES]
     dones = batch[DONES]
     n = len(rewards)
+    if VF_NEXT in batch:
+        vf_next = batch[VF_NEXT]
+    else:  # legacy path: V(s_{t+1}) = values[t+1], fragment end = last_value
+        vf_next = np.concatenate(
+            [values[1:], np.asarray([last_value], values.dtype)]
+        )
+    truncs = batch.get(TRUNCATEDS, np.zeros(n, np.bool_))
     adv = np.zeros(n, np.float32)
     last_gae = 0.0
-    next_value = last_value
     for t in reversed(range(n)):
         nonterminal = 1.0 - float(dones[t])
-        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
-        last_gae = delta + gamma * lam * nonterminal * last_gae
+        chain = nonterminal * (1.0 - float(truncs[t]))
+        delta = rewards[t] + gamma * vf_next[t] * nonterminal - values[t]
+        last_gae = delta + gamma * lam * chain * last_gae
         adv[t] = last_gae
-        next_value = values[t]
     batch[ADVANTAGES] = adv
     batch[TARGETS] = adv + values
     return batch

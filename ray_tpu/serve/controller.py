@@ -71,6 +71,7 @@ class ServeController:
     # ------------------------------------------------------------------
     def deploy_app(self, app_name: str, deployments: List[dict],
                    ingress: str, route_prefix: Optional[str]):
+        to_stop: List[_DeploymentState] = []
         with self._lock:
             old = self._apps.get(app_name, {})
             new: Dict[str, _DeploymentState] = {}
@@ -79,7 +80,12 @@ class ServeController:
                 st = old.get(cfg.name)
                 if st is not None and st.serialized_init == d["init"] and \
                         st.config == cfg:
-                    new[cfg.name] = st  # unchanged: keep replicas
+                    # unchanged: keep replicas, but a redeploy always earns
+                    # a fresh chance — clear the give-up state so the
+                    # control loop retries failed starts
+                    st.broken = False
+                    st.consecutive_start_failures = 0
+                    new[cfg.name] = st
                 else:
                     fresh = _DeploymentState(app_name, cfg, d["init"])
                     if st is not None:
@@ -89,7 +95,7 @@ class ServeController:
                     new[cfg.name] = fresh
             for name, st in old.items():
                 if name not in new:
-                    self._stop_all(st)
+                    to_stop.append(st)
             self._apps[app_name] = new
             self._app_meta = getattr(self, "_app_meta", {})
             self._app_meta[app_name] = {
@@ -97,15 +103,19 @@ class ServeController:
                 "route_prefix": route_prefix if route_prefix is not None
                 else f"/{app_name}" if app_name != "default" else "/",
             }
+        # graceful stops block up to graceful_shutdown_timeout_s per replica:
+        # do them after releasing the lock so control RPCs stay responsive
+        for st in to_stop:
+            self._stop_all(st)
         return True
 
     def delete_app(self, app_name: str):
         with self._lock:
             app = self._apps.pop(app_name, None)
             getattr(self, "_app_meta", {}).pop(app_name, None)
-            if app:
-                for st in app.values():
-                    self._stop_all(st)
+        if app:
+            for st in app.values():
+                self._stop_all(st)
         return True
 
     def wait_for_ready(self, app_name: str, timeout_s: float = 60.0) -> bool:
@@ -163,8 +173,9 @@ class ServeController:
     def shutdown(self):
         self._shutdown.set()
         with self._lock:
-            for app in list(self._apps):
-                self.delete_app(app)
+            apps = list(self._apps)
+        for app in apps:
+            self.delete_app(app)
         return True
 
     # ------------------------------------------------------------------

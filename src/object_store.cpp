@@ -221,14 +221,21 @@ long rtpu_store_put(void* store, const char* oid_hex, const uint8_t* metadata,
   for (uint64_t i = 0; i < nbufs; ++i) data_len += buf_lens[i];
   const uint64_t total = kHeader + meta_len + data_len;
   {
+    // Reserve the bytes under the same lock as the capacity check so
+    // concurrent puts cannot each pass the check and overshoot capacity;
+    // the reservation is rolled back below once the real size is known.
     std::lock_guard<std::mutex> lock(s->mu);
     if (!s->EnsureSpaceLocked(total)) return -2;
+    s->used += total;
   }
   long written = rtpu_write_object(s->dir.c_str(), oid_hex, metadata,
                                    meta_len, bufs, buf_lens, nbufs);
-  if (written > 0) {
+  {
     std::lock_guard<std::mutex> lock(s->mu);
-    s->TrackLocked(oid_hex, static_cast<uint64_t>(written));
+    s->used -= total;  // release reservation (TrackLocked re-adds)
+    if (written > 0) {
+      s->TrackLocked(oid_hex, static_cast<uint64_t>(written));
+    }
   }
   return written;
 }

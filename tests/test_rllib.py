@@ -46,10 +46,46 @@ def test_vtrace_on_policy_reduces_to_td():
     logp = jnp.zeros(n)
     rewards = jnp.ones(n)
     values = jnp.zeros(n)
+    next_values = jnp.zeros(n)  # V(s_{t+1}) per step; fragment end = 0
     dones = jnp.zeros(n, bool)
-    vs, pg = vtrace(logp, logp, rewards, values, jnp.array(0.0), dones, 1.0)
+    truncs = jnp.zeros(n, bool)
+    vs, pg = vtrace(logp, logp, rewards, values, next_values, dones,
+                    truncs, 1.0)
     # on-policy, gamma=1, zero values: vs[t] = sum of remaining rewards
     assert np.allclose(np.asarray(vs), [5, 4, 3, 2, 1])
+
+
+def test_vtrace_truncation_cuts_chain_keeps_bootstrap():
+    import jax.numpy as jnp
+
+    n = 4
+    logp = jnp.zeros(n)
+    rewards = jnp.ones(n)
+    values = jnp.zeros(n)
+    # truncation after t=1 bootstraps from V(final obs)=10, and the
+    # correction chain must not leak t>=2 rewards into t<=1 targets
+    next_values = jnp.array([0.0, 10.0, 0.0, 0.0])
+    dones = jnp.zeros(n, bool)
+    truncs = jnp.array([False, True, False, False])
+    vs, _ = vtrace(logp, logp, rewards, values, next_values, dones,
+                   truncs, 1.0)
+    # t=1: delta = 1 + 10 - 0 = 11; t=0: 1 + vs[1] = 12 (within episode)
+    assert np.allclose(np.asarray(vs), [12, 11, 2, 1])
+
+
+def test_gae_truncation_bootstraps_final_obs():
+    batch = SampleBatch({
+        "rewards": np.array([1.0, 1.0, 1.0], np.float32),
+        "values": np.array([0.0, 0.0, 0.0], np.float32),
+        "dones": np.array([False, False, False]),
+        "truncateds": np.array([False, True, False]),
+        # V(s_{t+1}): t=1 truncates with V(final obs)=10; others chain
+        "vf_next": np.array([0.0, 10.0, 7.0], np.float32),
+    })
+    out = compute_gae(batch, last_value=0.0, gamma=1.0, lam=1.0)
+    # t=2 (new episode): 1 + 7 = 8; t=1: 1 + 10 = 11 (chain cut, no leak
+    # of t=2 into t=1); t=0: 1 + adv[1] = 12
+    assert np.allclose(out["advantages"], [12.0, 11.0, 8.0])
 
 
 def test_replay_buffers():
