@@ -103,6 +103,9 @@ class TaskSpec:
     seq_no: int = 0  # per-caller actor-task ordering
     caller_id: Optional[bytes] = None
     attempt: int = 0
+    # Times this task was re-executed to recover a lost return object
+    # (ray: object_recovery_manager.h lineage reconstruction budget).
+    reconstructions: int = 0
     submit_time: float = field(default_factory=time.time)
 
     def scheduling_class(self) -> tuple:

@@ -89,6 +89,11 @@ _flag("node_death_timeout_s", float, 10.0)
 _flag("gcs_rpc_timeout_s", float, 30.0)
 _flag("task_retry_delay_ms", int, 100)
 _flag("actor_restart_delay_ms", int, 100)
+# Reference counting / lineage (ray: reference_count.h, object_recovery_manager.h)
+_flag("borrower_poll_timeout_s", float, 600.0)
+_flag("borrower_poll_retries", int, 6)
+_flag("max_lineage_cache_entries", int, 4096)
+_flag("max_object_reconstructions", int, 3)
 # Memory monitor
 _flag("memory_usage_threshold", float, 0.95)
 _flag("memory_monitor_refresh_ms", int, 250)

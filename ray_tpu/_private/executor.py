@@ -183,6 +183,8 @@ class TaskExecutor:
         return args, kwargs
 
     async def _resolve_one(self, slot):
+        from ray_tpu._private.worker import _deser_container
+
         kind = slot[0]
         if kind == "v":
             return serialization.deserialize(slot[1], slot[2])
@@ -198,7 +200,10 @@ class TaskExecutor:
                 raise RuntimeError(f"task argument {oid_bytes.hex()[:16]} unavailable")
         # Do not release the buffer: returned values may alias the mmap; the
         # mapping stays alive as long as any view does (plasma zero-copy).
-        return serialization.deserialize(buf.metadata, buf.data)
+        # Refs nested in the value are borrowed *through* this argument
+        # object; record the provenance for the borrower handoff.
+        with _deser_container(oid_bytes):
+            return serialization.deserialize(buf.metadata, buf.data)
 
     # ------------------------------------------------------------------
     def _package_returns(self, spec: TaskSpec, value: Any, start: float):
@@ -213,16 +218,24 @@ class TaskExecutor:
             return self._error_result(sv, app_error=True)
         results = []
         stored = []
+        returns_nested = {}
+        return_pins = []
         tid = TaskID(spec.task_id)
         for i, v in enumerate(values):
             try:
                 sv = serialization.serialize(v)
             except Exception as e:
                 esv = serialization.serialize_error(e, spec.name)
+                for t in return_pins:
+                    self.cw.unpin_object(t)
                 return self._error_result(esv, app_error=True)
             if sv.nested_refs:
-                # Refs escaping via a return value: owner must keep them alive.
-                self.cw.pin_escaped(sv.nested_refs)
+                # Refs escaping via a return value: pin them here until the
+                # caller has registered as their borrower and acks with
+                # release_return_pins (reference_count.h return handoff).
+                returns_nested[i] = list(sv.nested_refs)
+                for oid_b, owner in sv.nested_refs:
+                    return_pins.append(self.cw.pin_object(oid_b, owner))
             if sv.total_data_len <= cfg.max_direct_call_object_size:
                 results.append(("v", sv.metadata, sv.to_bytes()))
             else:
@@ -232,11 +245,30 @@ class TaskExecutor:
                 )
                 stored.append(oid.binary())
                 results.append(("r", oid.binary()))
+        if return_pins:
+            with self.cw._lock:
+                self.cw._return_pins[spec.task_id] = return_pins
+            # Fallback: if the caller dies before acking release_return_pins,
+            # expire the pins instead of pinning the objects forever.
+            self.cw.io.call_soon(self._expire_return_pins(spec.task_id))
         return {
             "results": results,
             "stored_objects": stored,
             "duration": time.time() - start,
+            # Borrower-protocol report (ray: PushTaskReply.borrowed_refs):
+            # borrows this worker still holds (e.g. refs stashed in actor
+            # state) so the owner can register us before releasing arg pins.
+            "exec_addr": self.cw.addr,
+            "borrows_kept": self.cw.borrowed_refs_held(),
+            "returns_nested": returns_nested or None,
         }
+
+    async def _expire_return_pins(self, task_id: bytes):
+        await asyncio.sleep(cfg.borrower_poll_timeout_s)
+        with self.cw._lock:
+            pins = self.cw._return_pins.pop(task_id, None)
+        for token in pins or ():
+            self.cw.unpin_object(token)
 
     def _error_result(self, sv: serialization.SerializedValue, app_error: bool):
         return {
@@ -245,4 +277,8 @@ class TaskExecutor:
             "error_value": (sv.metadata, sv.to_bytes()),
             "app_error": app_error,
             "retriable": True,
+            # Even a failed task may have stashed arg refs (actor state):
+            # report them so the owner keeps those objects alive.
+            "exec_addr": self.cw.addr,
+            "borrows_kept": self.cw.borrowed_refs_held(),
         }

@@ -432,13 +432,15 @@ class GcsServer:
         return [r.to_table() for r in self.actors.values()]
 
     async def rpc_wait_actor_alive(self, conn: Connection, p):
-        """Block until the actor is ALIVE or DEAD; returns its table entry."""
+        """Block until the actor is ALIVE or DEAD; returns its table entry.
+
+        An unknown actor_id is awaited too (not failed immediately): the
+        registration may legitimately trail task submission when the actor's
+        creation arguments are still being resolved by the owner."""
         deadline = time.monotonic() + p.get("timeout", cfg.gcs_rpc_timeout_s)
         while time.monotonic() < deadline:
             rec = self.actors.get(p["actor_id"])
-            if rec is None:
-                return None
-            if rec.state in (ALIVE, DEAD):
+            if rec is not None and rec.state in (ALIVE, DEAD):
                 return rec.to_table()
             await asyncio.sleep(0.02)
         rec = self.actors.get(p["actor_id"])

@@ -344,7 +344,8 @@ class Raylet:
             if not ok:
                 del self.waiting[tid]
                 await self._send_task_failure(
-                    qt.spec, f"failed to fetch dependency {oid.hex()[:16]}", retriable=True
+                    qt.spec, f"failed to fetch dependency {oid.hex()[:16]}",
+                    retriable=True, lost_object=oid,
                 )
                 continue
             qt.pending_deps.discard(oid)
@@ -432,6 +433,11 @@ class Raylet:
             "app_error": result.get("app_error", False),
             "retriable": result.get("retriable", False),
             "attempt": spec.attempt,
+            # borrower-protocol fields (ray: reference_count.h borrowed_refs
+            # reported in PushTaskReply)
+            "exec_addr": result.get("exec_addr"),
+            "borrows_kept": result.get("borrows_kept"),
+            "returns_nested": result.get("returns_nested"),
         }
         await self._route_to_owner(spec.owner, "task_result", payload)
 
@@ -463,12 +469,14 @@ class Raylet:
             except Exception:
                 pass
 
-    async def _send_task_failure(self, spec: TaskSpec, reason: str, retriable: bool):
+    async def _send_task_failure(self, spec: TaskSpec, reason: str, retriable: bool,
+                                 lost_object: Optional[bytes] = None):
         await self._route_to_owner(
             spec.owner,
             "task_result",
             {"task_id": spec.task_id, "results": None, "error": reason,
-             "system_error": True, "retriable": retriable, "attempt": spec.attempt},
+             "system_error": True, "retriable": retriable, "attempt": spec.attempt,
+             "lost_object": lost_object},
         )
 
     # ------------------------------------------------------------------
@@ -639,10 +647,11 @@ class Raylet:
         return {}
 
     async def rpc_pull_object(self, conn: Connection, p):
-        ok = await self._ensure_local(p["object_id"])
+        ok = await self._ensure_local(p["object_id"], timeout=p.get("timeout"))
         return {"ok": ok}
 
-    async def _ensure_local(self, oid_bytes: bytes) -> bool:
+    async def _ensure_local(self, oid_bytes: bytes,
+                            timeout: Optional[float] = None) -> bool:
         oid = ObjectID(oid_bytes)
         if self.store.contains(oid):
             return True
@@ -652,7 +661,7 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._pulls_inflight[oid_bytes] = fut
         try:
-            ok = await self._do_pull(oid)
+            ok = await self._do_pull(oid, timeout=timeout)
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -662,8 +671,8 @@ class Raylet:
         finally:
             self._pulls_inflight.pop(oid_bytes, None)
 
-    async def _do_pull(self, oid: ObjectID) -> bool:
-        deadline = time.monotonic() + cfg.object_pull_timeout_s
+    async def _do_pull(self, oid: ObjectID, timeout: Optional[float] = None) -> bool:
+        deadline = time.monotonic() + (timeout or cfg.object_pull_timeout_s)
         while time.monotonic() < deadline:
             try:
                 locs = await self.gcs.request(
@@ -744,6 +753,48 @@ class Raylet:
 
     def rpc_delete_object(self, conn: Connection, p):
         self.store.delete(ObjectID(p["object_id"]))
+
+    async def rpc_owner_call(self, conn: Connection, p):
+        """Route a request to an owning core worker anywhere in the cluster
+        (generic transport for the borrower protocol: borrow_add,
+        wait_ref_removed, release_return_pins, reconstruct_object —
+        ray: core_worker.h WaitForRefRemoved / owner RPCs)."""
+        node_id, client_id = tuple(p["owner"])
+        timeout = p.get("timeout", cfg.gcs_rpc_timeout_s)
+        if node_id == self.node_id:
+            c = self.clients.get(client_id)
+            if c is None or c.closed:
+                return {"owner_dead": True}
+            try:
+                return await c.request(p["method"], p["payload"], timeout=timeout)
+            except asyncio.TimeoutError:
+                return {"timeout": True}
+            except Exception:
+                return {"owner_dead": True}
+        peer = await self._peer(node_id)
+        if peer is None:
+            return {"owner_dead": True}
+        try:
+            return await peer.request("owner_call", p, timeout=timeout + 5.0)
+        except asyncio.TimeoutError:
+            return {"timeout": True}
+        except Exception:
+            return {"owner_dead": True}
+
+    async def rpc_report_lost_object(self, conn: Connection, p):
+        """Owner detected a lost plasma copy: drop the local record and the
+        GCS location so pulls don't chase a dead file
+        (ray: object_recovery_manager.h object-loss handling)."""
+        oid = p["object_id"]
+        self.store.delete(ObjectID(oid))
+        try:
+            await self.gcs.request(
+                "remove_object_location",
+                {"object_id": oid, "node_id": self.node_id},
+            )
+        except Exception:
+            pass
+        return {}
 
     async def rpc_fetch_owned_routed(self, conn: Connection, p):
         """Route a borrower's small-object fetch to the owning core worker

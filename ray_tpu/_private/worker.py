@@ -34,6 +34,24 @@ from ray_tpu._private.rpcio import Connection, EventLoopThread, connect
 
 logger = logging.getLogger(__name__)
 
+# Thread-local marker for "currently deserializing the value of container X":
+# refs rebuilt inside record X as their borrow provenance so the container's
+# owner can hand the borrow off when X is released (reference_count.h
+# borrowed-through-object tracking).
+_DESER_CTX = threading.local()
+
+
+class _deser_container:
+    def __init__(self, container_oid):
+        self.oid = container_oid
+
+    def __enter__(self):
+        self.prev = getattr(_DESER_CTX, "container", None)
+        _DESER_CTX.container = self.oid
+
+    def __exit__(self, *exc):
+        _DESER_CTX.container = self.prev
+
 
 class GetTimeoutError(TimeoutError):
     pass
@@ -108,14 +126,38 @@ class CoreWorker:
         self._specs_inflight: Dict[bytes, TaskSpec] = {}
         self._put_index = 0
         self._local_refs: Dict[bytes, int] = {}
-        self._submitted_refs: Dict[bytes, int] = {}
         self._owned: set = set()
-        self._borrowed: set = set()
-        # Owned objects whose refs were serialized out of this process: a
-        # borrower may resolve them at any time, so never auto-free them
-        # (conservative stand-in for the reference's borrower protocol,
-        # ray: reference_count.h WaitForRefRemoved).
-        self._escaped: set = set()
+        # --- borrower protocol (ray: reference_count.h:61) ----------------
+        # Owned oids pinned by outstanding serialized copies (task args in
+        # flight, containment handoffs). Count-based; released when the
+        # consuming side has registered as a borrower or finished.
+        self._escape_pins: Dict[bytes, int] = {}
+        # Owned oid -> set of remote worker addrs currently borrowing it.
+        # Each entry has an active wait_ref_removed long-poll task.
+        self._borrowers: Dict[bytes, set] = {}
+        # Owned container oid -> pin tokens for the refs nested inside it,
+        # released when the container is freed (ray: AddNestedObjectIds).
+        self._contains: Dict[bytes, list] = {}
+        # Borrow-side: oid -> {"count", "owner", "waiters"}; count covers
+        # live python refs, serialize-out holds, and containment holds.
+        self._borrow_state: Dict[bytes, dict] = {}
+        # Container oid -> child oids first borrowed while deserializing it
+        # (reported to the container's owner on release for handoff).
+        self._borrowed_via: Dict[bytes, set] = {}
+        # task_id -> pin tokens for refs serialized into its args.
+        self._task_arg_pins: Dict[bytes, list] = {}
+        # task_id -> pin tokens for refs serialized into returns we executed,
+        # held until the caller acks registration (release_return_pins).
+        self._return_pins: Dict[bytes, list] = {}
+        # actor_id -> pin tokens for actor-creation args (held until the
+        # actor is permanently DEAD: restarts replay the creation spec).
+        self._actor_creation_pins: Dict[bytes, list] = {}
+        self._actor_sub_done = False
+        # --- lineage (ray: object_recovery_manager.h:44) ------------------
+        # return oid -> producing TaskSpec (finalized args), for re-execution
+        # when the plasma copy is lost. FIFO-capped.
+        self._lineage: Dict[bytes, TaskSpec] = {}
+        self._reconstructing: Dict[bytes, concurrent.futures.Future] = {}
         self._actor_seq: Dict[bytes, int] = {}
         self._pubsub_handlers: Dict[str, list] = {}
         self.connected = True
@@ -123,44 +165,52 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # argument encoding / submitter-side dependency resolution
     # ------------------------------------------------------------------
-    def _encode_value(self, value: Any) -> Tuple:
+    def _encode_value(self, value: Any, pins: List) -> Tuple:
         sv = serialization.serialize(value)
-        if sv.nested_refs:
-            self.pin_escaped(sv.nested_refs)
+        for oid, owner in sv.nested_refs:
+            # Refs inside an inlined arg value escape this process: pin them
+            # until the consuming task resolves and its executor has
+            # registered any kept borrows (ray: reference_count.h arg pins).
+            pins.append(self.pin_object(oid, owner))
         if sv.total_data_len <= cfg.max_direct_call_object_size:
             return ("v", sv.metadata, sv.to_bytes())
         ref = self._put_serialized(sv)
         # Keep the implicit put alive until the consuming task finishes.
-        self._submitted_refs[ref.binary()] = self._submitted_refs.get(ref.binary(), 0) + 1
+        pins.append(self.pin_object(ref.binary(), ref.owner))
         return ("r", ref.binary(), ref.owner)
 
-    def _encode_slots(self, args, kwargs):
+    def _encode_slots(self, args, kwargs, pins: List):
         """Encode values eagerly; refs become ('pending', ref) placeholders."""
         enc_args = [
-            ("pending", a) if isinstance(a, ObjectRef) else self._encode_value(a)
+            ("pending", a) if isinstance(a, ObjectRef) else self._encode_value(a, pins)
             for a in args
         ]
         enc_kwargs = {
-            k: (("pending", v) if isinstance(v, ObjectRef) else self._encode_value(v))
+            k: (("pending", v) if isinstance(v, ObjectRef)
+                else self._encode_value(v, pins))
             for k, v in (kwargs or {}).items()
         }
         pending = [s[1] for s in enc_args if s[0] == "pending"]
         pending += [s[1] for s in enc_kwargs.values() if s[0] == "pending"]
         return enc_args, enc_kwargs, pending
 
-    def _finalize_slot(self, slot):
+    def _finalize_slot(self, slot, pins: List):
         if slot[0] != "pending":
             return slot
         ref: ObjectRef = slot[1]
+        # Pin for the task's lifetime whether owned (escape pin) or borrowed
+        # (our borrow must outlive the handoff to the executor).
+        pins.append(self.pin_object(ref.binary(), ref.owner))
         with self._lock:
             inline = self._memory_store.get(ref.binary())
         if inline is not None:
+            # Inlining the stored bytes: any refs nested in them stay alive
+            # through the pin on the containing object (its _contains pins).
             return ("v", inline[0], inline[1])
-        self._submitted_refs[ref.binary()] = self._submitted_refs.get(ref.binary(), 0) + 1
         return ("r", ref.binary(), ref.owner or self.addr)
 
     async def _submit_when_ready(self, spec: TaskSpec, enc_args, enc_kwargs,
-                                 pending: List[ObjectRef]):
+                                 pending: List[ObjectRef], pins: List):
         try:
             for ref in pending:
                 fut = self.future_for(ref)
@@ -170,12 +220,20 @@ class CoreWorker:
         except Exception as e:
             self._fail_returns(spec, f"dependency resolution failed: {e}")
             return
-        spec.args = [self._finalize_slot(s) for s in enc_args]
-        spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+        spec.args = [self._finalize_slot(s, pins) for s in enc_args]
+        spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
+        with self._lock:
+            self._task_arg_pins[spec.task_id] = pins
         try:
             await self.raylet.request("submit_task", {"spec": spec})
         except Exception as e:
             self._fail_returns(spec, f"task submission failed: {e}")
+
+    def _release_task_pins(self, task_id: bytes):
+        with self._lock:
+            pins = self._task_arg_pins.pop(task_id, None)
+        for token in pins or ():
+            self.unpin_object(token)
 
     def _fail_returns(self, spec: TaskSpec, message: str):
         sv = serialization.serialize_error(RuntimeError(message), spec.name)
@@ -185,6 +243,7 @@ class CoreWorker:
         for i in range(spec.num_returns):
             oid = ObjectID.from_index(tid, i + 1)
             self._resolve_inline(oid.binary(), sv.metadata, sv.to_bytes())
+        self._release_task_pins(spec.task_id)
 
     # ------------------------------------------------------------------
     # submission
@@ -212,7 +271,8 @@ class CoreWorker:
             resources = rewrite_resources_for_pg(
                 resources, scheduling.pg_id, scheduling.pg_bundle_index
             )
-        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        pins: List = []
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id,
@@ -229,7 +289,9 @@ class CoreWorker:
             runtime_env=runtime_env,
         )
         refs = self._register_returns(spec)
-        self.io.call_soon(self._submit_when_ready(spec, enc_args, enc_kwargs, pending))
+        self.io.call_soon(
+            self._submit_when_ready(spec, enc_args, enc_kwargs, pending, pins)
+        )
         return refs
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -272,7 +334,8 @@ class CoreWorker:
             resources = rewrite_resources_for_pg(
                 resources, scheduling.pg_id, scheduling.pg_bundle_index
             )
-        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        pins: List = []
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(actor_id).binary(),
             job_id=self.job_id,
@@ -294,8 +357,10 @@ class CoreWorker:
             caller_id=self.client_id.encode(),
         )
         if not pending:
-            spec.args = [self._finalize_slot(s) for s in enc_args]
-            spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+            spec.args = [self._finalize_slot(s, pins) for s in enc_args]
+            spec.kwargs = {k: self._finalize_slot(s, pins)
+                           for k, s in enc_kwargs.items()}
+            self._hold_actor_creation_pins(actor_id.binary(), pins)
             reply = self.io.run(
                 self.gcs.request("register_actor", {"spec": spec}),
                 timeout=cfg.gcs_rpc_timeout_s,
@@ -304,11 +369,14 @@ class CoreWorker:
                 raise ValueError(reply["error"])
         else:
             self.io.call_soon(
-                self._register_actor_when_ready(spec, enc_args, enc_kwargs, pending)
+                self._register_actor_when_ready(
+                    spec, enc_args, enc_kwargs, pending, pins
+                )
             )
         return actor_id.binary()
 
-    async def _register_actor_when_ready(self, spec, enc_args, enc_kwargs, pending):
+    async def _register_actor_when_ready(self, spec, enc_args, enc_kwargs,
+                                         pending, pins):
         for ref in pending:
             try:
                 await asyncio.wait_for(
@@ -317,9 +385,35 @@ class CoreWorker:
                 )
             except Exception:
                 logger.error("actor %s creation dependency failed", spec.name)
-        spec.args = [self._finalize_slot(s) for s in enc_args]
-        spec.kwargs = {k: self._finalize_slot(s) for k, s in enc_kwargs.items()}
+        spec.args = [self._finalize_slot(s, pins) for s in enc_args]
+        spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
+        self._hold_actor_creation_pins(spec.actor_id, pins)
         await self.gcs.request("register_actor", {"spec": spec})
+
+    def _hold_actor_creation_pins(self, actor_id: bytes, pins: List):
+        """Actor-creation args must survive restarts: the GCS replays the
+        creation spec on failure, so the pins are held until the actor is
+        permanently DEAD (ray: gcs_actor_manager.h lineage of creation spec)."""
+        if not pins:
+            return
+        with self._lock:
+            self._actor_creation_pins[actor_id] = pins
+        if not self._actor_sub_done:
+            self._actor_sub_done = True
+            # Register the handler synchronously and schedule the GCS
+            # subscribe as a loop task: this may run ON the io loop
+            # (_register_actor_when_ready), where a blocking io.run would
+            # deadlock the loop against itself.
+            self._pubsub_handlers.setdefault("actor", []).append(self._on_actor_event)
+            self.io.call_soon(self.gcs.request("subscribe", {"channel": "actor"}))
+
+    def _on_actor_event(self, table: dict):
+        if table.get("state") != "DEAD":
+            return
+        with self._lock:
+            pins = self._actor_creation_pins.pop(table.get("actor_id"), None)
+        for token in pins or ():
+            self.unpin_object(token)
 
     def submit_actor_task(
         self,
@@ -334,7 +428,8 @@ class CoreWorker:
         with self._lock:
             seq = self._actor_seq.get(actor_id, 0)
             self._actor_seq[actor_id] = seq + 1
-        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs)
+        pins: List = []
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
         spec = TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id,
@@ -350,7 +445,9 @@ class CoreWorker:
             caller_id=self.client_id.encode(),
         )
         refs = self._register_returns(spec)
-        self.io.call_soon(self._submit_when_ready(spec, enc_args, enc_kwargs, pending))
+        self.io.call_soon(
+            self._submit_when_ready(spec, enc_args, enc_kwargs, pending, pins)
+        )
         return refs
 
     def get_actor_table(self, actor_id: Optional[bytes] = None,
@@ -402,23 +499,78 @@ class CoreWorker:
                 self._resolve_inline(oid.binary(), res[1], res[2])
             else:
                 self._resolve_plasma(oid.binary())
+        if spec is not None and any(r[0] == "r" for r in results):
+            self._record_lineage(spec)
+        # Borrower handoff, ordered so an object is always pinned somewhere:
+        # 1. register borrows the executor kept (it holds arg refs until we
+        #    do — our arg pins keep the containers alive meanwhile);
+        # 2. register nested refs inside returns with their owners on our
+        #    behalf, then ack the executor so it drops its return pins;
+        # 3. only then release our own arg pins.
+        exec_addr = p.get("exec_addr")
+        if exec_addr is not None:
+            for oid, owner in p.get("borrows_kept") or ():
+                await self._register_borrow_for(oid, owner, tuple(exec_addr))
+            nested_map = p.get("returns_nested") or {}
+            if nested_map:
+                for i, nested in nested_map.items():
+                    roid = ObjectID.from_index(tid, int(i) + 1).binary()
+                    await self._adopt_contains(roid, nested)
+                await self._owner_call(
+                    exec_addr, "release_return_pins", {"task_id": task_id}
+                )
         if spec is not None:
-            self._release_submitted_refs(spec)
+            self._release_task_pins(task_id)
         # Returns whose refs were already dropped can be freed now.
         for i in range(len(results)):
             self._maybe_free(ObjectID.from_index(tid, i + 1).binary())
 
-    def _release_submitted_refs(self, spec: TaskSpec):
-        for a in list(spec.args) + list(spec.kwargs.values()):
-            if a[0] == "r":
-                with self._lock:
-                    n = self._submitted_refs.get(a[1], 0) - 1
-                    if n <= 0:
-                        self._submitted_refs.pop(a[1], None)
-                    else:
-                        self._submitted_refs[a[1]] = n
-                        continue
-                self._maybe_free(a[1])
+    async def _register_borrow_for(self, oid: bytes, owner, borrower: tuple):
+        """Register ``borrower`` with ``oid``'s owner (us or remote)."""
+        if owner is not None and tuple(owner) == self.addr:
+            self._register_borrower(oid, borrower)
+        elif owner is not None and tuple(owner) != borrower:
+            await self._owner_call(
+                owner, "borrow_add", {"object_id": oid, "borrower": borrower}
+            )
+
+    async def _adopt_contains(self, container_oid: bytes, nested):
+        """We now own ``container_oid`` whose value holds ``nested`` refs:
+        pin each (borrow-acquire if foreign) and register with its owner.
+        Released when the container is freed (ray: AddNestedObjectIds)."""
+        tokens = []
+        for oid, owner in nested:
+            tokens.append(self.pin_object(oid, owner))
+            await self._register_borrow_for(oid, owner, self.addr)
+        with self._lock:
+            if container_oid in self._owned:
+                self._contains.setdefault(container_oid, []).extend(tokens)
+                tokens = []
+        for t in tokens:  # container already freed: drop immediately
+            self.unpin_object(t)
+
+    async def _owner_call(self, owner, method: str, payload, timeout=None):
+        try:
+            return await self.raylet.request(
+                "owner_call",
+                {"owner": tuple(owner), "method": method, "payload": payload,
+                 "timeout": timeout or cfg.gcs_rpc_timeout_s},
+                timeout=(timeout or cfg.gcs_rpc_timeout_s) + 10.0,
+            )
+        except Exception:
+            return {"owner_dead": True}
+
+    def _record_lineage(self, spec: TaskSpec):
+        """Remember the finalized spec so lost plasma returns can be
+        re-executed (ray: task_manager.h lineage pinning, FIFO-capped)."""
+        tid = TaskID(spec.task_id)
+        with self._lock:
+            for i in range(spec.num_returns):
+                self._lineage[ObjectID.from_index(tid, i + 1).binary()] = spec
+            overflow = len(self._lineage) - cfg.max_lineage_cache_entries
+            if overflow > 0:
+                for oid in list(self._lineage)[:overflow]:
+                    del self._lineage[oid]
 
     async def _handle_task_error(self, spec: Optional[TaskSpec], task_id: bytes, p):
         retriable = p.get("retriable", False)
@@ -429,6 +581,21 @@ class CoreWorker:
             spec.attempt += 1
             logger.info("retrying task %s (attempt %d)", spec.name, spec.attempt)
             await asyncio.sleep(cfg.task_retry_delay_ms / 1000.0)
+            if p.get("lost_object"):
+                # A dependency's plasma copy is gone cluster-wide: try lineage
+                # reconstruction before the retry (object_recovery_manager.h).
+                # The dependency's owner lives in the matching "r" arg slot.
+                lost = p["lost_object"]
+                lost_owner = None
+                if spec is not None:
+                    for a in list(spec.args) + list(spec.kwargs.values()):
+                        if a[0] == "r" and a[1] == lost and len(a) > 2:
+                            lost_owner = a[2]
+                            break
+                try:
+                    await self._ensure_object_available(lost, lost_owner)
+                except Exception as e:
+                    logger.warning("dependency recovery failed: %s", e)
             try:
                 await self.raylet.request("submit_task", {"spec": spec})
                 return
@@ -453,7 +620,13 @@ class CoreWorker:
             oid = ObjectID.from_index(tid, i + 1)
             self._resolve_inline(oid.binary(), meta, data)
         if spec is not None:
-            self._release_submitted_refs(spec)
+            # A failed task may still have stashed arg refs (actor state):
+            # register those borrows before dropping our arg pins.
+            exec_addr = p.get("exec_addr")
+            if exec_addr is not None:
+                for oid_b, owner in p.get("borrows_kept") or ():
+                    await self._register_borrow_for(oid_b, owner, tuple(exec_addr))
+            self._release_task_pins(task_id)
 
     def _resolve_inline(self, oid: bytes, metadata: bytes, data: bytes):
         with self._lock:
@@ -522,16 +695,21 @@ class CoreWorker:
         return self._put_serialized(sv)
 
     def _put_serialized(self, sv: serialization.SerializedValue) -> ObjectRef:
-        if sv.nested_refs:
-            self.pin_escaped(sv.nested_refs)
         with self._lock:
             self._put_index += 1
             idx = self._put_index
         oid = ObjectID.for_put(self.task_id, idx)
+        # Refs nested in the stored value are kept alive by this container
+        # until it is freed (ray: reference_count.h AddNestedObjectIds). The
+        # nested refs are live python ObjectRefs here, so their borrows are
+        # already registered with their owners; the pin extends the lifecycle.
+        tokens = [self.pin_object(o, w) for o, w in sv.nested_refs]
         if sv.total_data_len <= cfg.max_direct_call_object_size:
             with self._lock:
                 self._memory_store[oid.binary()] = (sv.metadata, sv.to_bytes())
                 self._owned.add(oid.binary())
+                if tokens:
+                    self._contains[oid.binary()] = tokens
         else:
             object_store.write_object(
                 self.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
@@ -539,6 +717,8 @@ class CoreWorker:
             self.io.run(self.raylet.request("register_put", {"object_id": oid.binary()}))
             with self._lock:
                 self._owned.add(oid.binary())
+                if tokens:
+                    self._contains[oid.binary()] = tokens
         ref = ObjectRef(oid, self.addr)
         self.add_local_ref(ref)
         return ref
@@ -624,20 +804,73 @@ class CoreWorker:
 
     def _materialize(self, ref: ObjectRef, kind, meta, data):
         if kind == "inline":
-            return serialization.deserialize(meta, data)
+            with _deser_container(ref.binary()):
+                return serialization.deserialize(meta, data)
         oid = ref.id()
         buf = object_store.read_object(self.store_dir, oid)
         if buf is None:
             ok = self.io.run(self.raylet.request("pull_object", {"object_id": ref.binary()}))
-            if not ok.get("ok"):
-                raise GetTimeoutError(f"object {ref} lost and could not be re-fetched")
-            buf = object_store.read_object(self.store_dir, oid)
+            if ok.get("ok"):
+                buf = object_store.read_object(self.store_dir, oid)
+        if buf is None:
+            # Plasma copy gone cluster-wide (or the local file was deleted
+            # behind a stale store record): invalidate, re-pull, and fall
+            # back to lineage reconstruction (object_recovery_manager.h:44).
+            buf, inline = self._recover_object(ref)
             if buf is None:
-                raise GetTimeoutError(f"object {ref} unavailable")
+                with _deser_container(ref.binary()):
+                    return serialization.deserialize(*inline)
         with self._lock:
-            old = self._pinned_buffers.pop(ref.binary(), None)
+            self._pinned_buffers.pop(ref.binary(), None)
             self._pinned_buffers[ref.binary()] = buf
-        return serialization.deserialize(buf.metadata, buf.data)
+        with _deser_container(ref.binary()):
+            return serialization.deserialize(buf.metadata, buf.data)
+
+    def _recover_object(self, ref: ObjectRef):
+        """Returns (buffer, None) or (None, (meta, data)) for a value that
+        came back inline (e.g. the reconstructed task errored)."""
+        oid = ref.id()
+        try:
+            self.io.run(self.raylet.request(
+                "report_lost_object", {"object_id": ref.binary()}))
+            # Short probe: if no other node holds a copy, fail fast into
+            # reconstruction instead of waiting out the full pull timeout.
+            ok = self.io.run(self.raylet.request(
+                "pull_object", {"object_id": ref.binary(), "timeout": 2.0}))
+            if ok.get("ok"):
+                buf = object_store.read_object(self.store_dir, oid)
+                if buf is not None:
+                    return buf, None
+        except Exception:
+            pass
+        owner = ref.owner
+        if owner is not None and tuple(owner) != self.addr:
+            # Borrowed: ask the owner to reconstruct, then pull again.
+            r = self.io.run(self._owner_call(
+                owner, "reconstruct_object", {"object_id": ref.binary()},
+                timeout=cfg.object_pull_timeout_s * 2,
+            ))
+            if r.get("ok"):
+                ok = self.io.run(self.raylet.request(
+                    "pull_object", {"object_id": ref.binary()}))
+                if ok.get("ok"):
+                    buf = object_store.read_object(self.store_dir, oid)
+                    if buf is not None:
+                        return buf, None
+            raise GetTimeoutError(f"object {ref} lost; owner could not recover it")
+        fut = self.io.run(self._reconstruct_owned(ref.binary()))
+        kind, meta, data = fut.result(cfg.object_pull_timeout_s * 2)
+        if kind == "inline":
+            return None, (meta, data)
+        buf = object_store.read_object(self.store_dir, oid)
+        if buf is None:
+            ok = self.io.run(self.raylet.request(
+                "pull_object", {"object_id": ref.binary()}))
+            if ok.get("ok"):
+                buf = object_store.read_object(self.store_dir, oid)
+        if buf is None:
+            raise GetTimeoutError(f"object {ref} unavailable after reconstruction")
+        return buf, None
 
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
              fetch_local=True):
@@ -662,7 +895,19 @@ class CoreWorker:
         return ordered_ready, not_ready
 
     # ------------------------------------------------------------------
-    # reference counting (simplified; ray: reference_count.h:61)
+    # reference counting + borrower protocol (ray: reference_count.h:61)
+    #
+    # Owner side: an owned object stays alive while it has local python
+    # refs, escape pins (serialized copies in flight), or registered remote
+    # borrowers. Each registered borrower is long-polled (wait_ref_removed);
+    # its reply arrives when the borrower's last reference drops and carries
+    # any refs it borrowed *through* the object for handoff.
+    #
+    # Borrower side: one state per oid counting python refs + serialize-out
+    # holds + containment holds; when it hits zero, pending owner polls
+    # resolve. Every registration handoff is acknowledged before the pin
+    # protecting the object during the handoff is released, so the object is
+    # pinned somewhere at every instant.
     # ------------------------------------------------------------------
     def add_local_ref(self, ref: ObjectRef):
         with self._lock:
@@ -671,42 +916,313 @@ class CoreWorker:
 
     def remove_local_ref(self, ref_binary: bytes):
         with self._lock:
-            n = self._local_refs.get(ref_binary, 0) - 1
-            if n <= 0:
-                self._local_refs.pop(ref_binary, None)
+            if ref_binary in self._borrow_state and ref_binary not in self._owned:
+                borrowed = True
             else:
-                self._local_refs[ref_binary] = n
-                return
-        self._maybe_free(ref_binary)
+                borrowed = False
+                n = self._local_refs.get(ref_binary, 0) - 1
+                if n <= 0:
+                    self._local_refs.pop(ref_binary, None)
+                else:
+                    self._local_refs[ref_binary] = n
+                    return
+        if borrowed:
+            self._borrow_release(ref_binary)
+        else:
+            self._maybe_free(ref_binary)
 
     def register_borrowed_ref(self, ref: ObjectRef):
+        """Called for every deserialized ObjectRef. Owned refs round-tripping
+        home count as local refs; foreign refs start/extend a borrow."""
+        oid = ref.binary()
         with self._lock:
-            self._borrowed.add(ref.binary())
+            if oid in self._owned:
+                self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+                ref._counted = True
+                return
+            st = self._borrow_state.get(oid)
+            if st is None:
+                st = {"count": 0, "owner": None, "waiters": []}
+                self._borrow_state[oid] = st
+            st["count"] += 1
+            if st["owner"] is None and ref.owner is not None:
+                st["owner"] = tuple(ref.owner)
+            ref._counted = True
+            # Provenance tracking matters only when the container itself is a
+            # borrowed object with live state (its owner will poll us and the
+            # reply hands these children off). Owned containers pin children
+            # via _contains, and executor args report children directly in
+            # borrows_kept — recording those here would leak entries forever.
+            container = getattr(_DESER_CTX, "container", None)
+            if (container is not None and container != oid
+                    and container in self._borrow_state):
+                self._borrowed_via.setdefault(container, set()).add(oid)
 
-    def pin_escaped(self, nested_refs):
-        """Pin owned objects whose refs are leaving this process."""
+    def pin_object(self, oid: bytes, owner) -> tuple:
+        """Take one keep-alive pin: escape pin if owned, borrow hold if not.
+        Returns a token for unpin_object."""
         with self._lock:
-            for binary, _owner in nested_refs:
-                if binary in self._owned:
-                    self._escaped.add(binary)
+            if oid in self._owned:
+                self._escape_pins[oid] = self._escape_pins.get(oid, 0) + 1
+                return ("o", oid)
+            st = self._borrow_state.get(oid)
+            if st is None:
+                st = {"count": 0, "owner": None, "waiters": []}
+                self._borrow_state[oid] = st
+            st["count"] += 1
+            if st["owner"] is None and owner is not None:
+                st["owner"] = tuple(owner)
+            return ("b", oid)
+
+    def unpin_object(self, token: tuple):
+        kind, oid = token
+        if kind == "o":
+            with self._lock:
+                n = self._escape_pins.get(oid, 0) - 1
+                if n <= 0:
+                    self._escape_pins.pop(oid, None)
+                else:
+                    self._escape_pins[oid] = n
+                    return
+            self._maybe_free(oid)
+        else:
+            self._borrow_release(oid)
+
+    def _borrow_release(self, oid: bytes):
+        with self._lock:
+            st = self._borrow_state.get(oid)
+            if st is None:
+                return
+            st["count"] -= 1
+            if st["count"] > 0:
+                return
+            self._borrow_state.pop(oid, None)
+            waiters = st["waiters"]
+            # Children first borrowed while deserializing this object that
+            # are still live: hand them off to the container's owner.
+            inherited = []
+            for child in self._borrowed_via.pop(oid, ()):
+                cst = self._borrow_state.get(child)
+                if cst is not None and cst.get("owner"):
+                    inherited.append((child, cst["owner"]))
+        if waiters:
+            def _resolve():
+                for f in waiters:
+                    if not f.done():
+                        f.set_result(inherited)
+            self.io.loop.call_soon_threadsafe(_resolve)
+
+    def borrowed_refs_held(self):
+        """Live borrows of this process: [(oid, owner)] — reported to task
+        owners at completion (ray: PushTaskReply.borrowed_refs)."""
+        with self._lock:
+            return [
+                (oid, st["owner"])
+                for oid, st in self._borrow_state.items()
+                if st["count"] > 0 and st.get("owner")
+            ]
+
+    # -- owner-side borrower registry ----------------------------------
+    def _register_borrower(self, oid: bytes, borrower: tuple):
+        if tuple(borrower) == self.addr:
+            return
+        with self._lock:
+            if oid not in self._owned:
+                return
+            s = self._borrowers.setdefault(oid, set())
+            if tuple(borrower) in s:
+                return
+            s.add(tuple(borrower))
+        self.io.call_soon(self._poll_borrower(oid, tuple(borrower)))
+
+    async def _poll_borrower(self, oid: bytes, borrower: tuple):
+        """Long-poll one borrower until it drops the ref (WaitForRefRemoved).
+        A dead borrower is pruned after a few failures."""
+        failures = 0
+        while True:
+            with self._lock:
+                if oid not in self._owned or borrower not in self._borrowers.get(oid, ()):
+                    return
+            r = await self._owner_call(
+                borrower, "wait_ref_removed", {"object_id": oid},
+                timeout=cfg.borrower_poll_timeout_s,
+            )
+            if r.get("timeout"):
+                failures = 0
+                continue
+            if r.get("removed"):
+                for child, child_owner in r.get("inherited", ()):
+                    await self._register_borrow_for(child, child_owner, borrower)
+                break
+            failures += 1
+            if failures >= cfg.borrower_poll_retries:
+                logger.warning(
+                    "borrower %s of %s unreachable; dropping its borrow",
+                    borrower, oid.hex()[:16],
+                )
+                break
+            # Exponential backoff: a brief raylet/peer outage must not free
+            # an object a live borrower still uses (transient errors and a
+            # dead borrower look the same through the routing layer).
+            await asyncio.sleep(min(30.0, 2.0 ** failures))
+        with self._lock:
+            s = self._borrowers.get(oid)
+            if s is not None:
+                s.discard(borrower)
+                if not s:
+                    self._borrowers.pop(oid, None)
+        self._maybe_free(oid)
+
+    async def rpc_borrow_add(self, conn: Connection, p):
+        self._register_borrower(p["object_id"], tuple(p["borrower"]))
+        return {"ok": True}
+
+    async def rpc_wait_ref_removed(self, conn: Connection, p):
+        oid = p["object_id"]
+        with self._lock:
+            st = self._borrow_state.get(oid)
+            if st is None or st["count"] <= 0:
+                inherited = []
+                for child in self._borrowed_via.pop(oid, ()):
+                    cst = self._borrow_state.get(child)
+                    if cst is not None and cst.get("owner"):
+                        inherited.append((child, cst["owner"]))
+                return {"removed": True, "inherited": inherited}
+            fut = asyncio.get_running_loop().create_future()
+            st["waiters"].append(fut)
+        try:
+            inherited = await asyncio.wait_for(
+                fut, cfg.borrower_poll_timeout_s * 0.9
+            )
+            return {"removed": True, "inherited": inherited}
+        except asyncio.TimeoutError:
+            return {"removed": False}
+
+    async def rpc_release_return_pins(self, conn: Connection, p):
+        """Caller has registered the borrows for refs nested in our returned
+        value: drop the pins we held across the handoff."""
+        with self._lock:
+            pins = self._return_pins.pop(p["task_id"], None)
+        for token in pins or ():
+            self.unpin_object(token)
+        return {}
+
+    async def rpc_reconstruct_object(self, conn: Connection, p):
+        """A borrower lost the plasma copy of an object we own: re-execute
+        the producing task (ray: object_recovery_manager.h:44)."""
+        oid = p["object_id"]
+        try:
+            fut = await self._reconstruct_owned(oid)
+            await asyncio.wait_for(
+                asyncio.wrap_future(fut), cfg.object_pull_timeout_s * 2
+            )
+            return {"ok": True}
+        except Exception as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # -- lineage reconstruction ----------------------------------------
+    async def _reconstruct_owned(self, oid: bytes) -> concurrent.futures.Future:
+        """Resubmit the producing task for a lost owned object. Returns the
+        (new) result future; dedupes concurrent reconstructions."""
+        with self._lock:
+            spec = self._lineage.get(oid)
+            if spec is None:
+                raise GetTimeoutError(
+                    f"object {oid.hex()[:16]} lost and has no lineage "
+                    "(puts are not reconstructable)"
+                )
+            if spec.task_id in self._specs_inflight:
+                # Reconstruction (or the original run) already in flight.
+                fut = self._futures.get(oid)
+                if fut is None:
+                    fut = concurrent.futures.Future()
+                    self._futures[oid] = fut
+                return fut
+            if spec.reconstructions >= cfg.max_object_reconstructions:
+                raise GetTimeoutError(
+                    f"object {oid.hex()[:16]} lost too many times "
+                    f"({spec.reconstructions})"
+                )
+            spec.reconstructions += 1
+            spec.attempt += 1
+            tid = TaskID(spec.task_id)
+            for i in range(spec.num_returns):
+                roid = ObjectID.from_index(tid, i + 1).binary()
+                self._futures[roid] = concurrent.futures.Future()
+            self._specs_inflight[spec.task_id] = spec
+            fut = self._futures[oid]
+        logger.info("reconstructing %s via task %s (attempt %d)",
+                    oid.hex()[:16], spec.name, spec.attempt)
+        try:
+            await self.raylet.request(
+                "report_lost_object", {"object_id": oid})
+        except Exception:
+            pass
+        # Recursively make sure the task's own args are obtainable.
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a[0] == "r":
+                try:
+                    await self._ensure_object_available(a[1], a[2] if len(a) > 2 else None)
+                except Exception as e:
+                    logger.warning("arg recovery for reconstruction failed: %s", e)
+        await self.raylet.request("submit_task", {"spec": spec})
+        return fut
+
+    async def _ensure_object_available(self, oid: bytes, owner=None):
+        """Make sure some live node holds oid, reconstructing if needed."""
+        locs = []
+        try:
+            locs = await self.gcs.request(
+                "get_object_locations", {"object_id": oid})
+        except Exception:
+            pass
+        if locs:
+            return
+        if object_store.object_exists(self.store_dir, ObjectID(oid)):
+            return
+        with self._lock:
+            owned = oid in self._owned
+        if owned:
+            fut = await self._reconstruct_owned(oid)
+            await asyncio.wait_for(
+                asyncio.wrap_future(fut), cfg.object_pull_timeout_s * 2
+            )
+        elif owner is not None:
+            r = await self._owner_call(
+                owner, "reconstruct_object", {"object_id": oid},
+                timeout=cfg.object_pull_timeout_s * 2,
+            )
+            if not r.get("ok"):
+                raise GetTimeoutError(
+                    f"owner could not recover {oid.hex()[:16]}: {r.get('error')}"
+                )
 
     def _maybe_free(self, oid: bytes):
         with self._lock:
-            if oid not in self._owned or oid in self._escaped:
+            if oid not in self._owned:
                 return
-            if self._local_refs.get(oid) or self._submitted_refs.get(oid):
+            if self._local_refs.get(oid) or self._escape_pins.get(oid) \
+                    or self._borrowers.get(oid):
                 return
-            if oid in self._specs_inflight:
-                return
+            tid = ObjectID(oid).task_id().binary()
+            if tid in self._specs_inflight:
+                return  # producing task still running
             self._owned.discard(oid)
             self._memory_store.pop(oid, None)
             self._futures.pop(oid, None)
+            # Lineage is deliberately NOT popped here: a downstream object's
+            # reconstruction may need to re-execute this object's producing
+            # task too (multi-hop recovery). The FIFO cap in _record_lineage
+            # bounds the memory (ray: lineage pinned while reachable).
+            contains = self._contains.pop(oid, None)
             buf = self._pinned_buffers.pop(oid, None)
         if buf is not None:
             try:
                 buf.release()
             except Exception:
                 pass
+        for token in contains or ():
+            self.unpin_object(token)
         try:
             self.io.call_soon(self.raylet.request("free_object", {"object_id": oid}))
         except Exception:
