@@ -1,0 +1,67 @@
+"""Allreduce scaling microbench (north-star metric #2).
+
+Measures compiled in-graph allreduce (`parallel.compiled_allreduce`) across
+mesh axis sizes 2/4/8 and payload sizes, printing one JSON line per point:
+{"devices": N, "bytes": B, "time_us": T, "algo_bw_gbps": ..., "scaling_eff": ...}
+
+scaling_eff = (per-device bus bandwidth at N) / (bus bandwidth at N=2); an
+ideal ring allreduce holds it near 1.0 as N grows. On real TPU hardware the
+transfer rides ICI; on the virtual CPU mesh (XLA_FLAGS
+--xla_force_host_platform_device_count=8) the numbers validate the scaling
+SHAPE, not absolute bandwidth.
+
+Reference anchor: ray benchmarks collectives via
+release/microbenchmark + util/collective NCCL paths; this is the XLA analog.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def run(sizes=(2, 4, 8), elems=(1 << 16, 1 << 20, 1 << 22), steps=5):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.collectives import compiled_allreduce
+
+    devices = jax.devices()
+    results = []
+    base_bw = {}
+    for n in sizes:
+        if n > len(devices):
+            continue
+        mesh = Mesh(np.array(devices[:n]), ("data",))
+        for ne in elems:
+            fn = compiled_allreduce(mesh, "data")
+            x = jnp.arange(ne, dtype=jnp.float32)
+            out = fn(x)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(x)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / steps
+            nbytes = ne * 4
+            # ring-allreduce bus bandwidth: 2*(n-1)/n * payload / time
+            bus_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
+            if n == sizes[0]:
+                base_bw[ne] = bus_bw
+            eff = bus_bw / base_bw.get(ne, bus_bw)
+            rec = {
+                "devices": n,
+                "bytes": nbytes,
+                "time_us": round(dt * 1e6, 1),
+                "algo_bw_gbps": round(bus_bw, 3),
+                "scaling_eff": round(eff, 3),
+            }
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    run()

@@ -82,6 +82,70 @@ def test_collective_store_backend(ray_start_regular):
     )
 
 
+@ray_tpu.remote
+class XlaCollectiveWorker:
+    """A rank in a jax.distributed gang — the real backend="xla" path."""
+
+    def setup(self, coordinator, world_size, rank):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator, num_processes=world_size, process_id=rank
+        )
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend="xla",
+                                  group_name="xg")
+        return rank
+
+    def do_ops(self, rank):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        out = {}
+        out["ar"] = col.allreduce(np.full((4,), float(rank + 1)), "xg")
+        out["ag"] = col.allgather(np.full((2,), float(rank + 1)), "xg")
+        out["bc"] = col.broadcast(np.full((3,), float(rank + 10)), src_rank=0,
+                                  group_name="xg")
+        out["rs"] = col.reducescatter(
+            np.arange(8, dtype=np.float32).reshape(4, 2) * (rank + 1), "xg"
+        )
+        col.barrier("xg")
+        return out
+
+
+def test_collective_xla_backend(ray_start_regular):
+    """backend="xla": ops run as compiled shard_map programs over a global
+    mesh spanning the jax.distributed gang (reference analog: the NCCL group
+    in ray: util/collective/collective_group/nccl_collective_group.py)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    coordinator = f"127.0.0.1:{port}"
+
+    workers = [XlaCollectiveWorker.remote() for _ in range(2)]
+    ray_tpu.get(
+        [w.setup.remote(coordinator, 2, i) for i, w in enumerate(workers)],
+        timeout=300,
+    )
+    outs = ray_tpu.get(
+        [w.do_ops.remote(i) for i, w in enumerate(workers)], timeout=300
+    )
+    for out in outs:
+        np.testing.assert_allclose(out["ar"], np.full((4,), 3.0))
+        np.testing.assert_allclose(out["ag"][0], np.full((2,), 1.0))
+        np.testing.assert_allclose(out["ag"][1], np.full((2,), 2.0))
+        np.testing.assert_allclose(out["bc"], np.full((3,), 10.0))
+    reduced = np.arange(8, dtype=np.float32).reshape(4, 2) * 3
+    np.testing.assert_allclose(outs[0]["rs"], reduced[:2])
+    np.testing.assert_allclose(outs[1]["rs"], reduced[2:])
+
+
 def test_mesh_and_ingraph_collectives():
     import jax
     import jax.numpy as jnp
