@@ -94,6 +94,11 @@ _flag("borrower_poll_timeout_s", float, 600.0)
 _flag("borrower_poll_retries", int, 6)
 _flag("max_lineage_cache_entries", int, 4096)
 _flag("max_object_reconstructions", int, 3)
+# GCS fault tolerance (ray: gcs_server.h:101-107 StorageType,
+# gcs_failover_worker_reconnect_timeout ray_config_def.h:62)
+_flag("gcs_failover_reconnect_timeout_s", float, 10.0)
+_flag("gcs_client_reconnect_timeout_s", float, 60.0)
+_flag("gcs_store_fsync", bool, False)
 # Memory monitor
 _flag("memory_usage_threshold", float, 0.95)
 _flag("memory_monitor_refresh_ms", int, 250)

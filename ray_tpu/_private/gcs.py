@@ -48,6 +48,30 @@ class ActorRecord:
         self.death_cause: Optional[str] = None
         self.owner_conn_key: Optional[str] = None  # owning driver/worker client id
 
+    def dump(self) -> dict:
+        """Persistable form (everything a restarted GCS needs to resume
+        managing this actor, incl. the creation spec for restarts)."""
+        return {
+            "spec": self.spec,
+            "state": self.state,
+            "node_id": self.node_id,
+            "address": self.address,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "owner_conn_key": self.owner_conn_key,
+        }
+
+    @classmethod
+    def restore(cls, d: dict) -> "ActorRecord":
+        rec = cls(d["spec"])
+        rec.state = d["state"]
+        rec.node_id = d["node_id"]
+        rec.address = tuple(d["address"]) if d["address"] else None
+        rec.num_restarts = d["num_restarts"]
+        rec.death_cause = d["death_cause"]
+        rec.owner_conn_key = d.get("owner_conn_key")
+        return rec
+
     def to_table(self):
         return {
             "actor_id": self.actor_id,
@@ -75,6 +99,22 @@ class PlacementGroupRecord:
         self.state = "PENDING"
         self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
 
+    def dump(self) -> dict:
+        return {
+            "pg_id": self.pg_id, "bundles": self.bundles,
+            "strategy": self.strategy, "name": self.name,
+            "job_id": self.job_id, "lifetime": self.lifetime,
+            "state": self.state, "bundle_nodes": self.bundle_nodes,
+        }
+
+    @classmethod
+    def restore(cls, d: dict) -> "PlacementGroupRecord":
+        pg = cls(d["pg_id"], d["bundles"], d["strategy"], d["name"],
+                 d["job_id"], d["lifetime"])
+        pg.state = d["state"]
+        pg.bundle_nodes = list(d["bundle_nodes"])
+        return pg
+
     def to_table(self):
         return {
             "placement_group_id": self.pg_id,
@@ -87,7 +127,10 @@ class PlacementGroupRecord:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        from ray_tpu._private.gcs_store import make_store
+
         self.server = RpcServer(self, host, port)
         self.nodes: Dict[str, NodeInfo] = {}
         self.node_conns: Dict[str, Connection] = {}
@@ -105,18 +148,100 @@ class GcsServer:
         self._started = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
         self.task_events: List[dict] = []  # bounded task-event log for state API
+        self._store = make_store(persist_path)
+        self._recovering: Set[bytes] = set()  # actor_ids awaiting raylet reclaim
+        self._recovered = self._replay()
+
+    def _replay(self) -> bool:
+        """Rebuild tables from the persistent store (ray: gcs_init_data.h —
+        a restarted GCS loads all tables before serving)."""
+        tables = self._store.load()
+        if not tables:
+            return False
+        for (ns, key), value in tables.get("kv", {}).items():
+            self.kv.setdefault(ns, {})[key] = value
+        for job_id, job in tables.get("job", {}).items():
+            self.jobs[job_id] = job
+        self._next_job = tables.get("meta", {}).get("next_job", 1)
+        for pg_id, d in tables.get("pg", {}).items():
+            if d["state"] != "REMOVED":
+                self.pgs[pg_id] = PlacementGroupRecord.restore(d)
+        for actor_id, d in tables.get("actor", {}).items():
+            rec = ActorRecord.restore(d)
+            self.actors[actor_id] = rec
+            if rec.name and rec.state != DEAD:
+                self.named_actors[(rec.namespace, rec.name)] = actor_id
+            if rec.state != DEAD:
+                # Raylets reconnect and reclaim still-running actors; the
+                # rest are failed over after the reconnect window.
+                rec.state = RESTARTING
+                self._recovering.add(actor_id)
+        logger.info(
+            "GCS restarted from store: %d actors (%d recovering), %d pgs, "
+            "%d jobs", len(self.actors), len(self._recovering), len(self.pgs),
+            len(self.jobs),
+        )
+        return True
 
     async def start(self):
         port = await self.server.start()
         self._tasks.append(asyncio.get_running_loop().create_task(self._health_loop()))
+        if self._recovered:
+            self._tasks.append(
+                asyncio.get_running_loop().create_task(self._finish_recovery())
+            )
         self._started.set()
         logger.info("GCS listening on %s", port)
         return port
+
+    async def _finish_recovery(self):
+        """After the failover window, restart recovering actors nobody
+        reclaimed and re-place PGs whose nodes never came back (ray:
+        gcs_failover_worker_reconnect_timeout, node_manager.proto:358
+        NotifyGCSRestart — our raylets reconnect and re-register instead)."""
+        await asyncio.sleep(cfg.gcs_failover_reconnect_timeout_s)
+        for actor_id in list(self._recovering):
+            self._recovering.discard(actor_id)
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec.state == RESTARTING:
+                await self._handle_actor_failure(
+                    rec, "actor lost during GCS failover"
+                )
+        for pg in list(self.pgs.values()):
+            if pg.state == "CREATED" and any(
+                nid not in self.nodes or not self.nodes[nid].alive
+                for nid in pg.bundle_nodes
+            ):
+                pg.state = "PENDING"
+                pg.bundle_nodes = [None] * len(pg.bundles)
+                self._persist_pg(pg)
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        # Jobs whose driver never reconnected: treat the driver as dead (its
+        # exit raced the GCS outage, so the disconnect cleanup never ran).
+        live_jobs = {
+            c.meta.get("job_id")
+            for c in self.client_conns.values()
+            if c.meta.get("is_driver")
+        }
+        for job_id, job in list(self.jobs.items()):
+            if not job["is_dead"] and job_id not in live_jobs:
+                await self._on_driver_exit(job_id)
+
+    # -- persistence write-through helpers ------------------------------
+    def _persist_actor(self, rec: ActorRecord):
+        self._store.put("actor", rec.actor_id, rec.dump())
+
+    def _persist_pg(self, pg: PlacementGroupRecord):
+        self._store.put("pg", pg.pg_id, pg.dump())
+
+    def _persist_job(self, job_id: bytes):
+        self._store.put("job", job_id, self.jobs[job_id])
 
     async def stop(self):
         for t in self._tasks:
             t.cancel()
         await self.server.stop()
+        self._store.close()
 
     # ------------------------------------------------------------------
     # Connection lifecycle
@@ -141,6 +266,7 @@ class GcsServer:
         if job:
             job["is_dead"] = True
             job["end_time"] = time.time()
+            self._persist_job(job_id)
         for rec in list(self.actors.values()):
             if rec.spec.job_id == job_id and rec.spec.lifetime != "detached" \
                     and rec.state != DEAD:
@@ -153,14 +279,41 @@ class GcsServer:
     # Node manager (+ health checks)
     # ------------------------------------------------------------------
     async def rpc_register_node(self, conn: Connection, info: dict):
+        state = info.pop("state", None)
         node = NodeInfo(**info)
         node.resources_available = dict(node.resources_total)
         self.nodes[node.node_id] = node
         conn.meta.update(kind="raylet", node_id=node.node_id)
         self.node_conns[node.node_id] = conn
+        if state:
+            await self._reconcile_node_state(node.node_id, state)
         await self._publish("node", {"event": "alive", "node": info})
         await self._broadcast_view()
         return {"node_id": node.node_id, "nodes": self._view()}
+
+    async def _reconcile_node_state(self, node_id: str, state: dict):
+        """A raylet re-registered after a GCS restart (or its own reconnect)
+        and reported what it is actually running; fold that back into the
+        replayed tables (reference analog: RayletNotifyGCSRestart +
+        per-table resubscription, core_worker.proto:417)."""
+        for actor_id, client_id in state.get("actors_running", {}).items():
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec.state != DEAD:
+                rec.node_id = node_id
+                rec.address = (node_id, client_id)
+                rec.state = ALIVE
+                self._recovering.discard(actor_id)
+                await self._publish_actor(rec)
+        for oid in state.get("objects", ()):
+            self.object_dir.setdefault(oid, set()).add(node_id)
+            for fut in self.object_waiters.pop(oid, []):
+                if not fut.done():
+                    fut.set_result([node_id])
+        for pg_id, bundle_index in state.get("pg_bundles", ()):
+            pg = self.pgs.get(pg_id)
+            if pg is not None and pg.state == "CREATED" \
+                    and 0 <= bundle_index < len(pg.bundle_nodes):
+                pg.bundle_nodes[bundle_index] = node_id
 
     async def rpc_heartbeat(self, conn: Connection, payload: dict):
         node = self.nodes.get(payload["node_id"])
@@ -237,6 +390,8 @@ class GcsServer:
             "namespace": payload.get("namespace") or "default",
             "end_time": None,
         }
+        self._persist_job(job_id)
+        self._store.put("meta", "next_job", self._next_job)
         return {"job_id": job_id}
 
     async def rpc_register_client(self, conn: Connection, payload: dict):
@@ -256,23 +411,30 @@ class GcsServer:
     # Internal KV (ray: gcs_kv_manager.h)
     # ------------------------------------------------------------------
     async def rpc_kv_put(self, conn: Connection, p):
-        ns = self.kv.setdefault(p.get("ns", ""), {})
+        nsname = p.get("ns", "")
+        ns = self.kv.setdefault(nsname, {})
         existed = p["key"] in ns
         if p.get("overwrite", True) or not existed:
             ns[p["key"]] = p["value"]
+            self._store.put("kv", (nsname, p["key"]), p["value"])
         return {"added": not existed}
 
     async def rpc_kv_get(self, conn: Connection, p):
         return self.kv.get(p.get("ns", ""), {}).get(p["key"])
 
     async def rpc_kv_del(self, conn: Connection, p):
-        ns = self.kv.get(p.get("ns", ""), {})
+        nsname = p.get("ns", "")
+        ns = self.kv.get(nsname, {})
         if p.get("prefix"):
             keys = [k for k in ns if k.startswith(p["key"])]
             for k in keys:
                 del ns[k]
+                self._store.put("kv", (nsname, k), None)
             return len(keys)
-        return 1 if ns.pop(p["key"], None) is not None else 0
+        if ns.pop(p["key"], None) is not None:
+            self._store.put("kv", (nsname, p["key"]), None)
+            return 1
+        return 0
 
     async def rpc_kv_keys(self, conn: Connection, p):
         ns = self.kv.get(p.get("ns", ""), {})
@@ -362,6 +524,7 @@ class GcsServer:
                     return {"error": f"actor name '{rec.name}' already taken"}
             self.named_actors[key] = rec.actor_id
         self.actors[rec.actor_id] = rec
+        self._persist_actor(rec)
         asyncio.get_running_loop().create_task(self._schedule_actor(rec))
         return {"actor_id": rec.actor_id}
 
@@ -415,6 +578,7 @@ class GcsServer:
         await self._publish_actor(rec)
 
     async def _publish_actor(self, rec: ActorRecord):
+        self._persist_actor(rec)
         await self._publish("actor", rec.to_table())
 
     async def rpc_get_actor(self, conn: Connection, p):
@@ -505,6 +669,7 @@ class GcsServer:
             p.get("job_id"), p.get("lifetime"),
         )
         self.pgs[pg.pg_id] = pg
+        self._persist_pg(pg)
         asyncio.get_running_loop().create_task(self._schedule_pg(pg))
         return {"pg_id": pg.pg_id}
 
@@ -517,6 +682,7 @@ class GcsServer:
             await asyncio.sleep(0.2)
         if pg.state == "PENDING":
             pg.state = "INFEASIBLE"
+            self._persist_pg(pg)
             await self._publish("pg", pg.to_table())
 
     async def _try_place_pg(self, pg: PlacementGroupRecord) -> bool:
@@ -569,6 +735,7 @@ class GcsServer:
                     )
                 pg.bundle_nodes = list(placement)
                 pg.state = "CREATED"
+                self._persist_pg(pg)
                 await self._publish("pg", pg.to_table())
                 return True
 
@@ -602,6 +769,7 @@ class GcsServer:
                 except Exception:
                     pass
         pg.state = "REMOVED"
+        self._persist_pg(pg)
         await self._publish("pg", pg.to_table())
 
     async def rpc_pg_table(self, conn: Connection, p):

@@ -12,7 +12,8 @@ import os
 async def amain(args):
     from ray_tpu._private.gcs import GcsServer
 
-    server = GcsServer(host=args.host, port=args.port)
+    server = GcsServer(host=args.host, port=args.port,
+                       persist_path=args.persist_path)
     port = await server.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
@@ -27,6 +28,8 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--port-file", default=None)
+    parser.add_argument("--persist-path", default=None,
+                        help="append-log file enabling GCS fault tolerance")
     args = parser.parse_args()
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),

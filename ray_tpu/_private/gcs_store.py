@@ -1,0 +1,106 @@
+"""Pluggable GCS persistence.
+
+Analog of the reference's StoreClient family
+(ray: src/ray/gcs/store_client/in_memory_store_client.h,
+redis_store_client.h; typed tables gcs_table_storage.h:50,248). The
+reference persists GCS tables to Redis so a restarted GCS replays state
+(`gcs_init_data.h`) and clients resubscribe. TPU-native we use an
+append-only log file on the head node (Redis isn't a baked-in dependency);
+the interface is small enough that a Redis/etcd client drops in.
+
+Records are length-prefixed pickles of ``(table, key, value)`` where
+``value=None`` tombstones the key. ``load()`` replays the log into
+``{table: {key: value}}`` and compacts it (rewrites live records only), so
+the log stays proportional to live state, not mutation count.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Dict, Optional
+
+_LEN = struct.Struct("<I")
+
+
+class NullStore:
+    """In-memory GCS: nothing survives restart (the default)."""
+
+    def load(self) -> Dict[str, dict]:
+        return {}
+
+    def put(self, table: str, key, value) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class FileLogStore:
+    """Append-only log with replay + compaction on load."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = None
+
+    def load(self) -> Dict[str, dict]:
+        tables: Dict[str, dict] = {}
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                while True:
+                    header = f.read(_LEN.size)
+                    if len(header) < _LEN.size:
+                        break
+                    (n,) = _LEN.unpack(header)
+                    blob = f.read(n)
+                    if len(blob) < n:  # torn tail write: stop replay here
+                        break
+                    try:
+                        table, key, value = pickle.loads(blob)
+                    except Exception:
+                        break
+                    if value is None:
+                        tables.get(table, {}).pop(key, None)
+                    else:
+                        tables.setdefault(table, {})[key] = value
+        self._compact(tables)
+        return tables
+
+    def _compact(self, tables: Dict[str, dict]) -> None:
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for table, entries in tables.items():
+                for key, value in entries.items():
+                    blob = pickle.dumps((table, key, value), protocol=5)
+                    f.write(_LEN.pack(len(blob)))
+                    f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+
+    def put(self, table: str, key, value) -> None:
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        blob = pickle.dumps((table, key, value), protocol=5)
+        with self._lock:
+            self._f.write(_LEN.pack(len(blob)))
+            self._f.write(blob)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def make_store(persist_path: Optional[str]):
+    return FileLogStore(persist_path) if persist_path else NullStore()

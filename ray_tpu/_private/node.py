@@ -79,11 +79,13 @@ class NodeProcesses:
         self.gcs_host = gcs_host
         self.gcs_proc: Optional[subprocess.Popen] = None
         suffix = uuid.uuid4().hex[:8]
+        self.gcs_persist_path = os.path.join(self.session_dir, "gcs_store.log")
         if head:
             port_file = os.path.join(self.session_dir, f"gcs_port_{suffix}")
             self.gcs_proc = _spawn(
                 [sys.executable, "-m", "ray_tpu._private.gcs_main",
-                 "--host", gcs_host, "--port", "0", "--port-file", port_file],
+                 "--host", gcs_host, "--port", "0", "--port-file", port_file,
+                 "--persist-path", self.gcs_persist_path],
                 os.path.join(self.logs, "gcs.out"),
                 env=dict(os.environ),
             )
@@ -120,6 +122,26 @@ class NodeProcesses:
         else:
             self.raylet_proc.kill()
         self.raylet_proc.wait(timeout=10)
+
+    def kill_gcs(self):
+        """Chaos hook: kill the GCS process (head only). State survives in
+        the persist log; ``restart_gcs`` brings it back on the same port."""
+        assert self.gcs_proc is not None, "kill_gcs only valid on the head"
+        self.gcs_proc.kill()
+        self.gcs_proc.wait(timeout=10)
+
+    def restart_gcs(self):
+        """Restart the GCS on its original port; it replays the persist log
+        and raylets/workers reconnect (ray: GCS FT via Redis restart +
+        RayletNotifyGCSRestart)."""
+        assert self.head, "restart_gcs only valid on the head"
+        self.gcs_proc = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.gcs_main",
+             "--host", self.gcs_host, "--port", str(self.gcs_port),
+             "--persist-path", self.gcs_persist_path],
+            os.path.join(self.logs, "gcs.out"),
+            env=dict(os.environ),
+        )
 
     def shutdown(self):
         for proc in (self.raylet_proc, self.gcs_proc):

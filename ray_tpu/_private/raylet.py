@@ -133,20 +133,58 @@ class Raylet:
     async def start(self):
         self.port = await self.server.start()
         self.gcs = await connect(self.gcs_host, self.gcs_port, handler=self, name="gcs-conn")
-        info = {
+        reply = await self.gcs.request(
+            "register_node", self._register_payload(), timeout=cfg.gcs_rpc_timeout_s
+        )
+        self._on_view(reply["nodes"])
+        self._tasks.append(asyncio.get_running_loop().create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(self._dispatch_loop()))
+        logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
+        return self.port
+
+    def _register_payload(self) -> dict:
+        """Node registration incl. a report of what this raylet is actually
+        running, so a restarted GCS reconciles its replayed tables
+        (reference analog: node_manager.proto:358 NotifyGCSRestart +
+        RayletNotifyGCSRestart, core_worker.proto:417)."""
+        return {
             "node_id": self.node_id,
             "host": self.host,
             "port": self.port,
             "store_dir": self.store_dir,
             "resources_total": self.resources_total,
             "labels": self.labels,
+            "state": {
+                "actors_running": {
+                    aid: w.client_id for aid, w in self.local_actors.items()
+                    if w.client_id
+                },
+                "objects": list(self.store.object_ids()),
+                "pg_bundles": [[pg_id, idx] for (pg_id, idx) in self.pg_bundles],
+            },
         }
-        reply = await self.gcs.request("register_node", info, timeout=cfg.gcs_rpc_timeout_s)
-        self._on_view(reply["nodes"])
-        self._tasks.append(asyncio.get_running_loop().create_task(self._heartbeat_loop()))
-        self._tasks.append(asyncio.get_running_loop().create_task(self._dispatch_loop()))
-        logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
-        return self.port
+
+    async def _gcs_reconnect_loop(self):
+        """The GCS connection dropped (GCS died or restarted): keep retrying
+        until it accepts us again, then re-register with our live state
+        (ray: gcs_rpc_server_reconnect_timeout_s — but we retry until the
+        raylet itself is stopped; the GCS owns deciding we are dead)."""
+        delay = 0.2
+        while not self._stopping:
+            try:
+                conn = await connect(self.gcs_host, self.gcs_port, handler=self,
+                                     name="gcs-conn")
+                reply = await conn.request(
+                    "register_node", self._register_payload(),
+                    timeout=cfg.gcs_rpc_timeout_s,
+                )
+                self.gcs = conn
+                self._on_view(reply["nodes"])
+                logger.info("raylet %s reconnected to GCS", self.node_id[:8])
+                return
+            except Exception:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 2.0)
 
     async def stop(self):
         self._stopping = True
@@ -164,7 +202,7 @@ class Raylet:
     async def _heartbeat_loop(self):
         while True:
             try:
-                await self.gcs.request(
+                reply = await self.gcs.request(
                     "heartbeat",
                     {
                         "node_id": self.node_id,
@@ -172,6 +210,14 @@ class Raylet:
                     },
                     timeout=cfg.gcs_rpc_timeout_s,
                 )
+                if reply.get("reregister"):
+                    # GCS restarted without dropping our conn (or evicted
+                    # us): re-register with our live state.
+                    reply = await self.gcs.request(
+                        "register_node", self._register_payload(),
+                        timeout=cfg.gcs_rpc_timeout_s,
+                    )
+                    self._on_view(reply["nodes"])
             except Exception:
                 pass
             await asyncio.sleep(cfg.heartbeat_interval_s)
@@ -238,6 +284,12 @@ class Raylet:
                 "resources_total": self.resources_total, "labels": self.labels}
 
     def on_disconnect(self, conn: Connection):
+        if conn is self.gcs:
+            if not self._stopping:
+                logger.warning("raylet %s lost GCS connection; reconnecting",
+                               self.node_id[:8])
+                return self._gcs_reconnect_loop()
+            return None
         kind = conn.meta.get("kind")
         if kind in ("driver", "worker"):
             cid = conn.meta.get("client_id")

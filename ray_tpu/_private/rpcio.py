@@ -222,6 +222,11 @@ async def connect(host: str, port: int, handler=None, name: str = "client",
         try:
             reader, writer = await asyncio.open_connection(host, port)
             conn = Connection(reader, writer, handler, name=name)
+            # Client-side conns get disconnect callbacks too (raylet/worker
+            # GCS-reconnect loops key off this).
+            cb = getattr(handler, "on_disconnect", None)
+            if cb is not None:
+                conn.on_close = cb
             conn.start()
             return conn
         except (ConnectionRefusedError, OSError) as e:

@@ -680,6 +680,43 @@ class CoreWorker:
         logging.shutdown()
         os._exit(0)
 
+    def on_disconnect(self, conn: Connection):
+        """Client-side connection loss. A dropped GCS conn means the GCS died
+        or restarted: reconnect + re-register + resubscribe (reference
+        analog: the auto-reconnect GcsClient decorator, _raylet.pyx:2124 +
+        pubsub resubscribe on RayletNotifyGCSRestart)."""
+        if conn is self.gcs and getattr(self, "connected", False):
+            return self._gcs_reconnect_loop()
+        return None
+
+    async def _gcs_reconnect_loop(self):
+        deadline = (
+            asyncio.get_running_loop().time()
+            + cfg.gcs_client_reconnect_timeout_s
+        )
+        delay = 0.2
+        while getattr(self, "connected", False):
+            if asyncio.get_running_loop().time() > deadline:
+                logger.error("GCS unreachable for %.0fs; giving up",
+                             cfg.gcs_client_reconnect_timeout_s)
+                return
+            try:
+                conn = await connect(self.gcs_addr[0], self.gcs_addr[1],
+                                     handler=self, name="gcs-conn")
+                await conn.request(
+                    "register_client",
+                    {"client_id": self.client_id, "job_id": self.job_id,
+                     "is_driver": self.is_driver},
+                )
+                for channel in self._pubsub_handlers:
+                    await conn.request("subscribe", {"channel": channel})
+                self.gcs = conn
+                logger.info("reconnected to GCS at %s:%s", *self.gcs_addr)
+                return
+            except Exception:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 2.0)
+
     def subscribe(self, channel: str, callback):
         self._pubsub_handlers.setdefault(channel, []).append(callback)
         self.io.run(self.gcs.request("subscribe", {"channel": channel}))
