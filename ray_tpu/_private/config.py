@@ -83,6 +83,10 @@ _flag("object_store_eviction_fraction", float, 0.8)
 _flag("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
 _flag("object_pull_timeout_s", float, 60.0)
 _flag("fetch_warn_timeout_s", float, 10.0)
+# Pull admission + spilling (ray: pull_manager.h:56, local_object_manager.h:40)
+_flag("max_concurrent_pulls", int, 8)
+_flag("pull_manager_memory_fraction", float, 0.5)
+_flag("object_spill_dir", str, "")
 # Health / fault tolerance
 _flag("heartbeat_interval_s", float, 0.5)
 _flag("node_death_timeout_s", float, 10.0)
@@ -99,9 +103,10 @@ _flag("max_object_reconstructions", int, 3)
 _flag("gcs_failover_reconnect_timeout_s", float, 10.0)
 _flag("gcs_client_reconnect_timeout_s", float, 60.0)
 _flag("gcs_store_fsync", bool, False)
-# Memory monitor
+# Memory monitor (ray: common/memory_monitor.h:52, worker_killing_policy.h)
 _flag("memory_usage_threshold", float, 0.95)
 _flag("memory_monitor_refresh_ms", int, 250)
+_flag("memory_monitor_test_path", str, "")  # test injection: file with a float
 # Metrics / events
 _flag("metrics_report_interval_s", float, 2.0)
 _flag("task_events_buffer_size", int, 10_000)

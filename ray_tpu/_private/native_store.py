@@ -54,6 +54,16 @@ def _configure(lib):
 
     lib.rtpu_store_create.restype = ctypes.c_void_p
     lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_store_create2.restype = ctypes.c_void_p
+    lib.rtpu_store_create2.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p
+    ]
+    lib.rtpu_store_restore.restype = ctypes.c_int
+    lib.rtpu_store_restore.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_is_spilled.restype = ctypes.c_int
+    lib.rtpu_store_is_spilled.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_spilled_bytes.restype = ctypes.c_uint64
+    lib.rtpu_store_spilled_bytes.argtypes = [ctypes.c_void_p]
     lib.rtpu_store_destroy.restype = None
     lib.rtpu_store_destroy.argtypes = [ctypes.c_void_p]
     lib.rtpu_store_put.restype = ctypes.c_long
@@ -195,13 +205,18 @@ def object_exists(store_dir: str, oid_hex: str) -> bool:
 class NativeLocalObjectStore:
     """Owner-side accounting store backed by the C++ RtpuStore."""
 
-    def __init__(self, store_dir: str, capacity_bytes: int):
+    def __init__(self, store_dir: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
         self._lib = load_library()
         assert self._lib is not None
         self.store_dir = store_dir
         self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
         self._store = ctypes.c_void_p(
-            self._lib.rtpu_store_create(store_dir.encode(), capacity_bytes)
+            self._lib.rtpu_store_create2(
+                store_dir.encode(), capacity_bytes,
+                (spill_dir or "").encode(),
+            )
         )
 
     # mirror of object_store.LocalObjectStore -------------------------
@@ -230,12 +245,30 @@ class NativeLocalObjectStore:
         from ray_tpu._private import object_store as pystore
 
         buf = pystore.read_object(self.store_dir, object_id)
+        if buf is None and self.restore_if_spilled(object_id):
+            buf = pystore.read_object(self.store_dir, object_id)
         if buf is not None:
             self._lib.rtpu_store_touch(self._store, object_id.hex().encode())
         return buf
 
     def contains(self, object_id) -> bool:
-        return object_exists(self.store_dir, object_id.hex())
+        return object_exists(self.store_dir, object_id.hex()) or bool(
+            self._lib.rtpu_store_is_spilled(
+                self._store, object_id.hex().encode()
+            )
+        )
+
+    def restore_if_spilled(self, object_id) -> bool:
+        return self._lib.rtpu_store_restore(
+            self._store, object_id.hex().encode()
+        ) == 1
+
+    def spilled_stats(self):
+        return {
+            "spilled_bytes_total": int(
+                self._lib.rtpu_store_spilled_bytes(self._store)
+            ),
+        }
 
     def pin(self, object_id):
         self._lib.rtpu_store_pin(self._store, object_id.hex().encode())

@@ -121,15 +121,20 @@ def write_object(
     return size
 
 
-def make_local_store(store_dir: str, capacity_bytes: int):
+def make_local_store(store_dir: str, capacity_bytes: int,
+                     spill_dir: Optional[str] = None):
     """Owner-side store factory: native C++ store (src/librtpu_store.so)
     when loadable, else the pure-Python implementation. Both share the
-    same on-disk format, so mixed clusters interoperate."""
+    same on-disk format, so mixed clusters interoperate. ``spill_dir``
+    (on real disk, not /dev/shm) enables spill-to-disk under memory
+    pressure (ray: local_object_manager.h:40)."""
     from ray_tpu._private import native_store
 
     if native_store.available():
-        return native_store.NativeLocalObjectStore(store_dir, capacity_bytes)
-    return LocalObjectStore(store_dir, capacity_bytes)
+        return native_store.NativeLocalObjectStore(
+            store_dir, capacity_bytes, spill_dir
+        )
+    return LocalObjectStore(store_dir, capacity_bytes, spill_dir)
 
 
 class LocalObjectStore:
@@ -141,15 +146,22 @@ class LocalObjectStore:
     eviction_policy.h:160).
     """
 
-    def __init__(self, store_dir: str, capacity_bytes: int):
+    def __init__(self, store_dir: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
         self.store_dir = store_dir
         os.makedirs(store_dir, exist_ok=True)
         self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._sizes: Dict[ObjectID, int] = {}
         self._lru: "OrderedDict[ObjectID, float]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._used = 0
+        self._spilled: Dict[ObjectID, int] = {}  # oid -> size on disk
+        self.spilled_bytes_total = 0
+        self.restored_bytes_total = 0
 
     # -- write path ----------------------------------------------------------
     def put(self, object_id: ObjectID, metadata: bytes, buffers, total_data_len: int):
@@ -178,6 +190,9 @@ class LocalObjectStore:
     # -- read path -----------------------------------------------------------
     def get(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
         buf = read_object(self.store_dir, object_id)
+        if buf is None and object_id in self._spilled:
+            if self.restore_if_spilled(object_id):
+                buf = read_object(self.store_dir, object_id)
         if buf is not None:
             with self._lock:
                 if object_id in self._lru:
@@ -185,7 +200,74 @@ class LocalObjectStore:
         return buf
 
     def contains(self, object_id: ObjectID) -> bool:
-        return object_exists(self.store_dir, object_id)
+        return object_exists(self.store_dir, object_id) \
+            or object_id in self._spilled
+
+    # -- spilling (ray: local_object_manager.h SpillObjects/restore) ---------
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.spill_dir, object_id.hex() + ".obj")
+
+    def _spill_locked(self, object_id: ObjectID) -> bool:
+        """Move one object's file from shm to the spill dir (cross-device
+        copy + unlink); the object stays addressable and is restored on
+        access. Pin counts survive: a spilled primary copy is still the
+        primary copy."""
+        src = _obj_path(self.store_dir, object_id)
+        dst = self._spill_path(object_id)
+        size = self._sizes.get(object_id, 0)
+        try:
+            with open(src, "rb") as fi, open(dst + ".tmp", "wb") as fo:
+                while True:
+                    chunk = fi.read(8 * 1024 * 1024)
+                    if not chunk:
+                        break
+                    fo.write(chunk)
+            os.replace(dst + ".tmp", dst)
+            os.unlink(src)
+        except OSError:
+            try:
+                os.unlink(dst + ".tmp")
+            except OSError:
+                pass
+            return False
+        self._sizes.pop(object_id, None)
+        self._lru.pop(object_id, None)
+        self._used -= size
+        self._spilled[object_id] = size
+        self.spilled_bytes_total += size
+        return True
+
+    def restore_if_spilled(self, object_id: ObjectID) -> bool:
+        """Bring a spilled object back into shm (ray:
+        spilled_object_reader.h — we restore whole objects)."""
+        with self._lock:
+            size = self._spilled.get(object_id)
+            if size is None:
+                return False
+            self._ensure_space_locked(size)
+            src = self._spill_path(object_id)
+            dst = _obj_path(self.store_dir, object_id)
+            try:
+                with open(src, "rb") as fi, open(dst + ".tmp", "wb") as fo:
+                    while True:
+                        chunk = fi.read(8 * 1024 * 1024)
+                        if not chunk:
+                            break
+                        fo.write(chunk)
+                os.replace(dst + ".tmp", dst)
+                os.unlink(src)
+            except OSError:
+                try:
+                    os.unlink(dst + ".tmp")
+                except OSError:
+                    pass
+                return False
+            self._spilled.pop(object_id, None)
+            self._sizes[object_id] = size
+            self._used += size
+            self._lru[object_id] = time.monotonic()
+            self.restored_bytes_total += size
+            return True
 
     # -- lifecycle -----------------------------------------------------------
     def pin(self, object_id: ObjectID):
@@ -209,6 +291,11 @@ class LocalObjectStore:
             os.unlink(_obj_path(self.store_dir, object_id))
         except FileNotFoundError:
             pass
+        if self._spilled.pop(object_id, None) is not None:
+            try:
+                os.unlink(self._spill_path(object_id))
+            except FileNotFoundError:
+                pass
         size = self._sizes.pop(object_id, 0)
         self._used -= size
         self._lru.pop(object_id, None)
@@ -216,24 +303,42 @@ class LocalObjectStore:
 
     def _ensure_space(self, size: int):
         with self._lock:
+            self._ensure_space_locked(size)
+
+    def _ensure_space_locked(self, size: int):
+        if self._used + size <= self.capacity:
+            return
+        # LRU-evict unpinned objects until there is room.
+        for oid in list(self._lru.keys()):
             if self._used + size <= self.capacity:
-                return
-            # LRU-evict unpinned objects until there is room.
+                break
+            if oid in self._pinned:
+                continue
+            self._delete_locked(oid)
+        # Still short: spill LRU objects (pinned primaries included) to
+        # disk instead of erroring (ray: local_object_manager.h:40).
+        if self._used + size > self.capacity and self.spill_dir:
             for oid in list(self._lru.keys()):
                 if self._used + size <= self.capacity:
                     break
-                if oid in self._pinned:
-                    continue
-                self._delete_locked(oid)
-            if self._used + size > self.capacity:
-                raise ObjectStoreFullError(
-                    f"object of size {size} does not fit: used={self._used} "
-                    f"capacity={self.capacity} (all remaining objects pinned)"
-                )
+                self._spill_locked(oid)
+        if self._used + size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of size {size} does not fit: used={self._used} "
+                f"capacity={self.capacity} (all remaining objects pinned)"
+            )
 
     def used_bytes(self) -> int:
         return self._used
 
+    def spilled_stats(self):
+        with self._lock:
+            return {
+                "spilled_objects": len(self._spilled),
+                "spilled_bytes_total": self.spilled_bytes_total,
+                "restored_bytes_total": self.restored_bytes_total,
+            }
+
     def object_ids(self):
         with self._lock:
-            return list(self._sizes.keys())
+            return list(self._sizes.keys()) + list(self._spilled.keys())

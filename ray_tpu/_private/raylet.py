@@ -22,10 +22,12 @@ CUDA_VISIBLE_DEVICES analog — one worker process owns all local chips.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
@@ -67,6 +69,60 @@ class _Worker:
         self.busy_with: Optional[bytes] = None  # task_id
         self.actor_id: Optional[bytes] = None
         self.registered = asyncio.get_running_loop().create_future()
+        self.started_at = time.monotonic()
+        self.oom_killed = False
+
+
+# Pull priorities (ray: pull_manager.h:31-38 BundlePriority — Get before
+# Wait before TaskArgs).
+PULL_PRIO_GET = 0
+PULL_PRIO_WAIT = 1
+PULL_PRIO_TASK_ARGS = 2
+
+
+class _PullGate:
+    """Pull admission control (ray: pull_manager.h:56 PullManager).
+
+    Limits concurrent inbound transfers by slot count and by an in-flight
+    byte budget, granting waiters in (priority, FIFO) order. A pull learns
+    its size from the first chunk and then ``charge``s the budget; the sole
+    active pull may always overshoot so a single huge object still
+    transfers (the reference's "admit at least one bundle" rule)."""
+
+    def __init__(self, max_concurrent: int, byte_budget: int):
+        self.max_concurrent = max_concurrent
+        self.byte_budget = byte_budget
+        self._active = 0
+        self._bytes = 0
+        self._seq = 0
+        self._waiters: List[tuple] = []  # heap of (priority, seq, future)
+
+    async def acquire(self, priority: int):
+        if self._active < self.max_concurrent and not self._waiters:
+            self._active += 1
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._waiters, (priority, self._seq, fut))
+        await fut
+
+    async def charge(self, nbytes: int):
+        """Reserve transfer bytes; waits while the budget is exhausted by
+        OTHER active transfers (never blocks the only charged pull)."""
+        while self._bytes > 0 and self._bytes + nbytes > self.byte_budget:
+            await asyncio.sleep(0.02)
+        self._bytes += nbytes
+
+    def uncharge(self, nbytes: int):
+        self._bytes -= nbytes
+
+    def release_slot(self):
+        self._active -= 1
+        while self._waiters and self._active < self.max_concurrent:
+            _, _, fut = heapq.heappop(self._waiters)
+            if not fut.done():
+                self._active += 1
+                fut.set_result(None)
 
 
 class _QueuedTask:
@@ -97,7 +153,15 @@ class Raylet:
         self.host = host
         self.server = RpcServer(self, host, port)
         self.store_dir = os.path.join(session_dir, f"store_{self.node_id[:12]}")
-        self.store = object_store.make_local_store(self.store_dir, cfg.object_store_memory)
+        # Spill dir lives on real disk, NOT /dev/shm: spilling must actually
+        # relieve memory (ray: object_spilling_config external storage).
+        spill_root = cfg.object_spill_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_spill"
+        )
+        self.spill_dir = os.path.join(spill_root, f"spill_{self.node_id[:12]}")
+        self.store = object_store.make_local_store(
+            self.store_dir, cfg.object_store_memory, self.spill_dir
+        )
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.labels = labels or {}
@@ -119,6 +183,10 @@ class Raylet:
         self.dep_waiters: Dict[bytes, List[bytes]] = {}  # object -> task_ids
         self.pg_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._pull_gate = _PullGate(
+            cfg.max_concurrent_pulls,
+            int(cfg.object_store_memory * cfg.pull_manager_memory_fraction),
+        )
         self._rr = [0]
         self._tasks: List[asyncio.Task] = []
         self._dispatch_event = asyncio.Event()
@@ -139,8 +207,84 @@ class Raylet:
         self._on_view(reply["nodes"])
         self._tasks.append(asyncio.get_running_loop().create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(self._dispatch_loop()))
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(self._memory_monitor_loop())
+        )
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
+
+    # ------------------------------------------------------------------
+    # OOM defense (ray: common/memory_monitor.h:52 MemoryMonitor +
+    # raylet/worker_killing_policy.h)
+    # ------------------------------------------------------------------
+    def _memory_usage_fraction(self) -> float:
+        if cfg.memory_monitor_test_path:
+            try:
+                with open(cfg.memory_monitor_test_path) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return 0.0
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    parts = line.split()
+                    info[parts[0].rstrip(":")] = int(parts[1])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", total)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_oom_victim(self) -> Optional[_Worker]:
+        """Worker-killing policy: prefer workers running retriable normal
+        tasks, newest-first (their lost progress is smallest and the task
+        resubmits); then non-actor busy workers; never idle pool workers
+        (killing them frees little) and actors only as a last resort —
+        matching the spirit of ray: worker_killing_policy_group_by_owner.h."""
+        busy = [w for w in self.all_workers.values() if w.busy_with is not None]
+        if not busy:
+            return None
+
+        def retriable(w: _Worker) -> bool:
+            qt = self.running.get(w.busy_with)
+            return qt is not None and qt.spec.max_retries != 0
+
+        tiers = (
+            [w for w in busy if w.actor_id is None and retriable(w)],
+            [w for w in busy if w.actor_id is None],
+            busy,
+        )
+        for tier in tiers:
+            if tier:
+                return max(tier, key=lambda w: w.started_at)
+        return None
+
+    async def _memory_monitor_loop(self):
+        while True:
+            await asyncio.sleep(cfg.memory_monitor_refresh_ms / 1000.0)
+            usage = self._memory_usage_fraction()
+            if usage <= cfg.memory_usage_threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "memory usage %.2f over threshold %.2f: killing worker "
+                "pid=%s (task=%s)", usage, cfg.memory_usage_threshold,
+                victim.proc.pid,
+                victim.busy_with.hex()[:16] if victim.busy_with else None,
+            )
+            victim.oom_killed = True
+            self.counters["workers_oom_killed"] = (
+                self.counters.get("workers_oom_killed", 0) + 1
+            )
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
 
     def _register_payload(self) -> dict:
         """Node registration incl. a report of what this raylet is actually
@@ -324,10 +468,15 @@ class Raylet:
             qt = self.running.pop(w.busy_with, None)
             if qt is not None:
                 res_add(self.resources_available, qt.resources)
-                await self._send_task_failure(
-                    qt.spec, f"worker died while executing (pid={w.proc.pid})",
-                    retriable=True,
-                )
+                if w.oom_killed:
+                    reason = (
+                        f"worker killed by the memory monitor under memory "
+                        f"pressure (pid={w.proc.pid}); the task will be "
+                        f"retried if retriable"
+                    )
+                else:
+                    reason = f"worker died while executing (pid={w.proc.pid})"
+                await self._send_task_failure(qt.spec, reason, retriable=True)
         self._dispatch_event.set()
 
     # ------------------------------------------------------------------
@@ -387,7 +536,7 @@ class Raylet:
         return missing
 
     async def _pull_for_dep(self, oid: bytes):
-        ok = await self._ensure_local(oid)
+        ok = await self._ensure_local(oid, priority=PULL_PRIO_TASK_ARGS)
         waiters = self.dep_waiters.pop(oid, [])
         for tid in waiters:
             qt = self.waiting.get(tid)
@@ -699,13 +848,21 @@ class Raylet:
         return {}
 
     async def rpc_pull_object(self, conn: Connection, p):
-        ok = await self._ensure_local(p["object_id"], timeout=p.get("timeout"))
+        ok = await self._ensure_local(
+            p["object_id"], timeout=p.get("timeout"),
+            priority=p.get("priority", PULL_PRIO_GET),
+        )
         return {"ok": ok}
 
     async def _ensure_local(self, oid_bytes: bytes,
-                            timeout: Optional[float] = None) -> bool:
+                            timeout: Optional[float] = None,
+                            priority: int = PULL_PRIO_GET) -> bool:
         oid = ObjectID(oid_bytes)
         if self.store.contains(oid):
+            # May be spilled: bring it back into shm so workers can mmap it.
+            restore = getattr(self.store, "restore_if_spilled", None)
+            if restore is not None:
+                restore(oid)
             return True
         fut = self._pulls_inflight.get(oid_bytes)
         if fut is not None:
@@ -713,7 +870,11 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._pulls_inflight[oid_bytes] = fut
         try:
-            ok = await self._do_pull(oid, timeout=timeout)
+            await self._pull_gate.acquire(priority)
+            try:
+                ok = await self._do_pull(oid, timeout=timeout)
+            finally:
+                self._pull_gate.release_slot()
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -769,23 +930,29 @@ class Raylet:
             return False
         total = first["total"]
         metadata = first["metadata"]
-        parts = [first["data"]]
-        got = len(first["data"])
-        while got < total:
-            try:
-                nxt = await peer.request(
-                    "fetch_object",
-                    {"object_id": oid.binary(), "offset": got, "chunk": chunk},
-                    timeout=cfg.gcs_rpc_timeout_s,
-                )
-            except Exception:
-                return False
-            if not nxt.get("exists"):
-                return False
-            parts.append(nxt["data"])
-            got += len(nxt["data"])
-        self.store.put(oid, metadata, parts, total)
-        return True
+        # Byte-budget admission: now that the size is known, reserve it so
+        # concurrent pulls cannot together overrun the transfer budget.
+        await self._pull_gate.charge(total)
+        try:
+            parts = [first["data"]]
+            got = len(first["data"])
+            while got < total:
+                try:
+                    nxt = await peer.request(
+                        "fetch_object",
+                        {"object_id": oid.binary(), "offset": got, "chunk": chunk},
+                        timeout=cfg.gcs_rpc_timeout_s,
+                    )
+                except Exception:
+                    return False
+                if not nxt.get("exists"):
+                    return False
+                parts.append(nxt["data"])
+                got += len(nxt["data"])
+            self.store.put(oid, metadata, parts, total)
+            return True
+        finally:
+            self._pull_gate.uncharge(total)
 
     async def rpc_fetch_object(self, conn: Connection, p):
         oid = ObjectID(p["object_id"])

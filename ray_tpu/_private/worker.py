@@ -911,6 +911,8 @@ class CoreWorker:
 
     def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None,
              fetch_local=True):
+        if not fetch_local:
+            return self._wait_no_fetch(refs, num_returns, timeout)
         futs = {self.future_for(r): r for r in refs}
         deadline = None if timeout is None else time.monotonic() + timeout
         done: set = set()
@@ -930,6 +932,49 @@ class CoreWorker:
         picked = set(ordered_ready)
         not_ready = [r for r in refs if r not in picked]
         return ordered_ready, not_ready
+
+    def _wait_no_fetch(self, refs, num_returns, timeout):
+        """wait(fetch_local=False): readiness without pulling the values to
+        this node (ray: wait's fetch_local contract — the reference only
+        checks object availability, it does not start a transfer)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: set = set()
+        while True:
+            for r in refs:
+                if r in ready:
+                    continue
+                if self._is_available_somewhere(r):
+                    ready.add(r)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        ordered_ready = [r for r in refs if r in ready][:num_returns]
+        picked = set(ordered_ready)
+        return ordered_ready, [r for r in refs if r not in picked]
+
+    def _is_available_somewhere(self, ref: ObjectRef) -> bool:
+        oid = ref.binary()
+        with self._lock:
+            if oid in self._memory_store:
+                return True
+            fut = self._futures.get(oid)
+        if fut is not None and fut.done() and fut.exception() is None:
+            return True
+        if object_store.object_exists(self.store_dir, ref.id()):
+            return True
+        owner = ref.owner
+        if owner is not None and tuple(owner) != self.addr:
+            try:
+                r = self.io.run(self.raylet.request(
+                    "fetch_owned_routed",
+                    {"owner": tuple(owner), "object_id": oid}, timeout=5.0,
+                ))
+            except Exception:
+                return False
+            return bool(r.get("inline") or r.get("plasma"))
+        return False
 
     # ------------------------------------------------------------------
     # reference counting + borrower protocol (ray: reference_count.h:61)

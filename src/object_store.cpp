@@ -151,10 +151,42 @@ int rtpu_object_exists(const char* store_dir, const char* oid_hex) {
 // (one instance inside the raylet; reference: ObjectLifecycleManager)
 // ---------------------------------------------------------------------------
 
+// Byte-copy src -> dst (cross-device safe: shm -> disk). Atomic via .tmp.
+static bool CopyFileRaw(const std::string& src, const std::string& dst) {
+  int in = ::open(src.c_str(), O_RDONLY);
+  if (in < 0) return false;
+  const std::string tmp = dst + ".tmp";
+  int out = ::open(tmp.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
+  if (out < 0) {
+    ::close(in);
+    return false;
+  }
+  char buf[1 << 20];
+  bool ok = true;
+  for (;;) {
+    ssize_t n = ::read(in, buf, sizeof(buf));
+    if (n == 0) break;
+    if (n < 0 || ::write(out, buf, n) != n) {
+      ok = false;
+      break;
+    }
+  }
+  ::close(in);
+  ::close(out);
+  if (!ok || ::rename(tmp.c_str(), dst.c_str()) != 0) {
+    ::unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
 struct RtpuStore {
   std::string dir;
+  std::string spill_dir;  // empty = spilling disabled
   uint64_t capacity = 0;
   uint64_t used = 0;
+  uint64_t spilled_bytes_total = 0;
+  uint64_t restored_bytes_total = 0;
   std::mutex mu;
   // LRU list front = oldest; map value = (size, pin_count, lru iterator)
   std::list<std::string> lru;
@@ -164,8 +196,22 @@ struct RtpuStore {
     std::list<std::string>::iterator it;
   };
   std::unordered_map<std::string, Entry> objects;
+  struct SpilledEntry {
+    uint64_t size;
+    int pins;  // a spilled primary copy is still the primary copy
+  };
+  std::unordered_map<std::string, SpilledEntry> spilled;
+
+  std::string SpillPath(const std::string& oid) const {
+    return spill_dir + "/" + oid + ".obj";
+  }
 
   void DeleteLocked(const std::string& oid) {
+    auto sp = spilled.find(oid);
+    if (sp != spilled.end()) {
+      ::unlink(SpillPath(oid).c_str());
+      spilled.erase(sp);
+    }
     auto found = objects.find(oid);
     if (found == objects.end()) return;
     ::unlink(ObjPath(dir, oid).c_str());
@@ -174,7 +220,22 @@ struct RtpuStore {
     objects.erase(found);
   }
 
-  // returns false if space cannot be made (everything pinned)
+  // Move one object's file shm -> spill dir, keeping it addressable
+  // (reference: local_object_manager.h:40 SpillObjects).
+  bool SpillOneLocked(const std::string& oid) {
+    auto found = objects.find(oid);
+    if (found == objects.end()) return false;
+    if (!CopyFileRaw(ObjPath(dir, oid), SpillPath(oid))) return false;
+    ::unlink(ObjPath(dir, oid).c_str());
+    spilled[oid] = SpilledEntry{found->second.size, found->second.pins};
+    used -= found->second.size;
+    spilled_bytes_total += found->second.size;
+    lru.erase(found->second.it);
+    objects.erase(found);
+    return true;
+  }
+
+  // returns false if space cannot be made (everything pinned, no spill dir)
   bool EnsureSpaceLocked(uint64_t size) {
     if (used + size <= capacity) return true;
     for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
@@ -183,6 +244,13 @@ struct RtpuStore {
       auto found = objects.find(oid);
       if (found == objects.end() || found->second.pins > 0) continue;
       DeleteLocked(oid);
+    }
+    if (used + size > capacity && !spill_dir.empty()) {
+      for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
+        const std::string oid = *it;
+        ++it;
+        SpillOneLocked(oid);
+      }
     }
     return used + size <= capacity;
   }
@@ -205,6 +273,49 @@ void* rtpu_store_create(const char* dir, uint64_t capacity) {
   s->dir = dir;
   s->capacity = capacity;
   return s;
+}
+
+// Variant with a spill directory (on real disk) enabling spill-to-disk
+// under memory pressure (reference: local_object_manager.h:40).
+void* rtpu_store_create2(const char* dir, uint64_t capacity,
+                         const char* spill_dir) {
+  auto* s = static_cast<RtpuStore*>(rtpu_store_create(dir, capacity));
+  if (spill_dir != nullptr && spill_dir[0] != '\0') {
+    s->spill_dir = spill_dir;
+    ::mkdir(spill_dir, 0755);
+  }
+  return s;
+}
+
+// Restore a spilled object into shm. 1 = restored, 0 = not spilled,
+// -1 = IO error or no room.
+int rtpu_store_restore(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto sp = s->spilled.find(oid_hex);
+  if (sp == s->spilled.end()) return 0;
+  const uint64_t size = sp->second.size;
+  const int pins = sp->second.pins;
+  if (!s->EnsureSpaceLocked(size)) return -1;
+  if (!CopyFileRaw(s->SpillPath(oid_hex), ObjPath(s->dir, oid_hex))) return -1;
+  ::unlink(s->SpillPath(oid_hex).c_str());
+  s->spilled.erase(oid_hex);
+  s->TrackLocked(oid_hex, size);
+  s->objects[oid_hex].pins = pins;
+  s->restored_bytes_total += size;
+  return 1;
+}
+
+int rtpu_store_is_spilled(void* store, const char* oid_hex) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->spilled.count(oid_hex) ? 1 : 0;
+}
+
+uint64_t rtpu_store_spilled_bytes(void* store) {
+  auto* s = static_cast<RtpuStore*>(store);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->spilled_bytes_total;
 }
 
 void rtpu_store_destroy(void* store) {
@@ -262,7 +373,12 @@ void rtpu_store_pin(void* store, const char* oid_hex) {
   auto* s = static_cast<RtpuStore*>(store);
   std::lock_guard<std::mutex> lock(s->mu);
   auto found = s->objects.find(oid_hex);
-  if (found != s->objects.end()) found->second.pins += 1;
+  if (found != s->objects.end()) {
+    found->second.pins += 1;
+    return;
+  }
+  auto sp = s->spilled.find(oid_hex);
+  if (sp != s->spilled.end()) sp->second.pins += 1;
 }
 
 void rtpu_store_unpin(void* store, const char* oid_hex) {
@@ -271,7 +387,10 @@ void rtpu_store_unpin(void* store, const char* oid_hex) {
   auto found = s->objects.find(oid_hex);
   if (found != s->objects.end() && found->second.pins > 0) {
     found->second.pins -= 1;
+    return;
   }
+  auto sp = s->spilled.find(oid_hex);
+  if (sp != s->spilled.end() && sp->second.pins > 0) sp->second.pins -= 1;
 }
 
 void rtpu_store_delete(void* store, const char* oid_hex) {
@@ -289,16 +408,22 @@ uint64_t rtpu_store_used(void* store) {
 uint64_t rtpu_store_count(void* store) {
   auto* s = static_cast<RtpuStore*>(store);
   std::lock_guard<std::mutex> lock(s->mu);
-  return s->objects.size();
+  return s->objects.size() + s->spilled.size();
 }
 
-// Fill up to cap entries of oid hex strings (65 bytes each incl NUL).
+// Fill up to cap entries of oid hex strings (65 bytes each incl NUL);
+// spilled objects are listed too (they are still addressable here).
 // Returns number written.
 uint64_t rtpu_store_list(void* store, char* out, uint64_t cap) {
   auto* s = static_cast<RtpuStore*>(store);
   std::lock_guard<std::mutex> lock(s->mu);
   uint64_t n = 0;
   for (const auto& kv : s->objects) {
+    if (n >= cap) break;
+    std::snprintf(out + n * 65, 65, "%s", kv.first.c_str());
+    ++n;
+  }
+  for (const auto& kv : s->spilled) {
     if (n >= cap) break;
     std::snprintf(out + n * 65, 65, "%s", kv.first.c_str());
     ++n;
