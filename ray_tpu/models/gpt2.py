@@ -260,6 +260,69 @@ def shard_train_state(params, opt_state, mesh: Mesh, fsdp: bool = False):
     return params, opt_state
 
 
+def shard_params_tp(params, mesh: Mesh, model_axis: str = "model"):
+    """Megatron-style tensor parallelism as GSPMD sharding annotations.
+
+    No model-code changes: column-shard the first matmul of each pair
+    (attention qkv, MLP up-projection) and row-shard the second (attention
+    output, MLP down-projection) over ``model_axis``; XLA's partitioner
+    propagates the sharding through the reshape into attention heads and
+    inserts the one allreduce per block after each row-sharded matmul —
+    the same comm pattern Megatron hand-codes with NCCL (reference
+    exercises TP via Alpa release tests,
+    ray: release/alpa_tests/train_opt_2_7b_minimum.py; SURVEY §2.9).
+
+    Embeddings, layernorms, and the (tied) LM head stay replicated: at
+    GPT-2 scale the vocab matmul is cheap relative to the blocks, and a
+    replicated wte keeps the fused cross-entropy local.
+    """
+    from jax.sharding import NamedSharding
+
+    col = PartitionSpec(None, model_axis)  # shard output features
+    row = PartitionSpec(model_axis, None)  # shard input features
+    colb = PartitionSpec(model_axis)       # bias of a column-sharded matmul
+    rep = PartitionSpec()
+
+    def spec_for(path) -> PartitionSpec:
+        keys = tuple(
+            p.key if hasattr(p, "key") else str(p) for p in path
+        )
+        if "c_attn" in keys or "c_fc" in keys:
+            return col if keys[-1] == "kernel" else colb
+        if "c_proj" in keys:
+            return row if keys[-1] == "kernel" else rep
+        return rep
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for(path)), params
+    )
+
+
+def shard_train_state_tp(params, opt_state, mesh: Mesh,
+                         model_axis: str = "model"):
+    """Place params + optimizer state with TP sharding (moments inherit
+    their parameter's layout)."""
+    p_sh = shard_params_tp(params, mesh, model_axis)
+    params = jax.tree.map(jax.device_put, params, p_sh)
+    p_treedef = jax.tree_util.tree_structure(params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == p_treedef
+        except Exception:
+            return False
+
+    from ray_tpu.parallel.mesh_utils import replicated
+
+    def place(node):
+        if is_params_like(node):
+            return jax.tree.map(jax.device_put, node, p_sh)
+        return jax.tree.map(lambda l: jax.device_put(l, replicated(mesh)), node)
+
+    opt_state = jax.tree.map(place, opt_state, is_leaf=is_params_like)
+    return params, opt_state
+
+
 def shard_batch(batch, mesh: Mesh):
     from ray_tpu.parallel.mesh_utils import data_sharding
 
