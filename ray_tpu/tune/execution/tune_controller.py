@@ -76,7 +76,9 @@ class TuneController:
         nested_resources: Optional[Dict[str, float]] = None,
         reuse_actors: bool = False,
         callbacks: Optional[list] = None,
+        experiment_dir: Optional[str] = None,
     ):
+        self._experiment_dir_override = experiment_dir
         if mode and mode not in ("min", "max"):
             raise ValueError("mode must be 'min' or 'max'")
         self._name = getattr(trainable, "__name__", "trainable")
@@ -128,6 +130,74 @@ class TuneController:
         self._live: Dict[object, tuple] = {}  # future -> (trial, kind)
         self._reusable_actors: List[object] = []
         self._searcher_done = False
+        self._state_interval_s = 10.0
+        self._last_state_save = 0.0
+
+    # ------------------------------------------------------------------
+    # experiment state snapshot/resume (ray parity:
+    # tune/execution/experiment_state.py _ExperimentCheckpointManager)
+    # ------------------------------------------------------------------
+    STATE_FILE = "experiment_state.pkl"
+
+    def save_experiment_state(self):
+        """Atomic snapshot of everything needed to resume: trials (incl.
+        checkpoint payloads), searcher + scheduler internals, and progress
+        counters. Actor handles live only in self._actors and are not
+        persisted."""
+        import pickle
+
+        import dataclasses
+
+        try:
+            run_config = dataclasses.replace(self._run_config, callbacks=None)
+        except Exception:  # noqa: BLE001
+            run_config = None
+        state = {
+            "trials": self.trials,
+            "searcher": self._searcher,
+            "scheduler": self._scheduler,
+            "searcher_done": self._searcher_done,
+            "expected": self._expected,
+            "name": self._name,
+            "metric": self._metric,
+            "mode": self._mode,
+            "num_samples": self._num_samples,
+            "param_space": self._param_space,
+            "run_config": run_config,
+        }
+        path = os.path.join(self._experiment_dir, self.STATE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f, protocol=5)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 — snapshot is best-effort
+            logger.warning("experiment state snapshot failed: %s", e)
+        self._last_state_save = time.monotonic()
+
+    def restore_experiment_state(self, state: dict, *,
+                                 resume_errored: bool = False,
+                                 restart_errored: bool = False):
+        """Adopt a snapshot: in-flight trials restart from their latest
+        checkpoint (RUNNING maps to PENDING; the actor is gone)."""
+        self.trials = list(state["trials"])
+        self._searcher = state["searcher"]
+        self._scheduler = state["scheduler"]
+        self._searcher_done = state["searcher_done"]
+        self._expected = state["expected"]
+        for t in self.trials:
+            if t.status in (Trial.RUNNING,):
+                t.status = Trial.PENDING
+            elif t.status == Trial.ERROR:
+                if restart_errored:
+                    t.status = Trial.PENDING
+                    t.checkpoint = None
+                    t.num_failures = 0
+                elif resume_errored:
+                    t.status = Trial.PENDING
+                    t.num_failures = 0
+            t.restore_pending = False
+            t.experiment_dir = self._experiment_dir
 
     # ------------------------------------------------------------------
     def _default_concurrency(self) -> int:
@@ -147,6 +217,9 @@ class TuneController:
             return max(os.cpu_count() or 4, 1)
 
     def _make_experiment_dir(self) -> str:
+        if self._experiment_dir_override:
+            os.makedirs(self._experiment_dir_override, exist_ok=True)
+            return self._experiment_dir_override
         base = self._run_config.storage_path or os.path.expanduser(
             "~/ray_tpu_results"
         )
@@ -415,6 +488,8 @@ class TuneController:
         for ref in ready:
             if ref in self._live:
                 self._process_ready(ref)
+        if time.monotonic() - self._last_state_save > self._state_interval_s:
+            self.save_experiment_state()
 
     def is_finished(self) -> bool:
         if self._stopper and self._stopper.stop_all():
@@ -437,6 +512,7 @@ class TuneController:
                     if not t.is_finished():
                         self._complete_trial(t, t.last_result or None)
         finally:
+            self.save_experiment_state()
             self.cleanup()
             for cb in self._callbacks:
                 cb.on_experiment_end(self)

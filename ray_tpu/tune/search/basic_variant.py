@@ -32,6 +32,25 @@ class BasicVariantGenerator(Searcher):
         self._iter: Optional[Iterator[Tuple[Dict, Dict]]] = None
         self._live = set()
         self.total_samples = 0
+        self._consumed = 0
+
+    # Experiment snapshot support: the live generator cannot pickle; resume
+    # rebuilds it and fast-forwards past the already-suggested variants
+    # (grid order is deterministic; random leaves of remaining samples just
+    # draw fresh values).
+    def __getstate__(self):
+        st = self.__dict__.copy()
+        st["_iter"] = None
+        return st
+
+    def __setstate__(self, st):
+        self.__dict__.update(st)
+        if self._space is not None and self._consumed:
+            consumed = self._consumed
+            self.set_space(self._space, self._num_samples)
+            for _ in range(consumed):
+                next(self._iter, None)
+            self._consumed = consumed
 
     def set_search_properties(self, metric, mode, config=None, **kwargs):
         super().set_search_properties(metric, mode, config, **kwargs)
@@ -61,6 +80,7 @@ class BasicVariantGenerator(Searcher):
             resolved, config = next(self._iter)
         except StopIteration:
             return Searcher.FINISHED
+        self._consumed += 1
         self._live.add(trial_id)
         config["__resolved_vars__"] = format_vars(resolved)
         return config

@@ -110,6 +110,9 @@ class Tuner:
         self._resources = resources
         self._nested_resources = nested_resources
         self._controller: Optional[TuneController] = None
+        self._restore_state: Optional[dict] = None
+        self._restore_dir: Optional[str] = None
+        self._restore_flags: Dict[str, bool] = {}
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -130,7 +133,13 @@ class Tuner:
             nested_resources=self._nested_resources,
             reuse_actors=tc.reuse_actors,
             callbacks=callbacks,
+            experiment_dir=self._restore_dir,
         )
+        if self._restore_state is not None:
+            self._controller.restore_experiment_state(
+                self._restore_state, **self._restore_flags
+            )
+            self._restore_state = None
         trials = self._controller.run()
         return ResultGrid(
             trials,
@@ -141,7 +150,48 @@ class Tuner:
 
     @classmethod
     def can_restore(cls, path: str) -> bool:
-        return False  # experiment-state restore lands with the syncer
+        """ray parity: Tuner.can_restore — a resumable experiment dir holds
+        a state snapshot (tune/execution/experiment_state.py)."""
+        import os
+
+        return os.path.exists(os.path.join(path, TuneController.STATE_FILE))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Union[Callable, type, Any],
+        *,
+        resume_errored: bool = False,
+        restart_errored: bool = False,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory (ray parity:
+        Tuner.restore). The trainable must be re-supplied (code is not
+        persisted); trials that were in flight restart from their latest
+        checkpoint, finished trials keep their results."""
+        import os
+        import pickle
+
+        state_path = os.path.join(path, TuneController.STATE_FILE)
+        with open(state_path, "rb") as f:
+            state = pickle.load(f)
+        tuner = cls(
+            trainable,
+            param_space=state.get("param_space"),
+            tune_config=TuneConfig(
+                metric=state.get("metric"),
+                mode=state.get("mode"),
+                num_samples=state.get("num_samples", 1),
+            ),
+            run_config=state.get("run_config"),
+        )
+        tuner._restore_state = state
+        tuner._restore_dir = path
+        tuner._restore_flags = {
+            "resume_errored": resume_errored,
+            "restart_errored": restart_errored,
+        }
+        return tuner
 
     def get_results(self) -> ResultGrid:
         if self._controller is None:

@@ -299,3 +299,70 @@ def test_function_trainable_without_checkpoint_has_none(ray_start_regular):
     ).fit()
     assert grid.num_errors == 0
     assert grid[0].checkpoint is None
+
+
+def test_experiment_snapshot_and_restore(ray_start_regular, tmp_path):
+    """Kill an experiment mid-flight, Tuner.restore, finish with trial
+    checkpoints intact (ray parity: tune/execution/experiment_state.py)."""
+
+    class Slow(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.it = 0
+
+        def step(self):
+            self.it += 1
+            return {"score": self.x * self.it, "done": self.it >= 6}
+
+        def save_checkpoint(self, checkpoint_dir=None):
+            return {"it": self.it}
+
+        def load_checkpoint(self, state):
+            self.it = state["it"]
+
+    from ray_tpu.air.config import CheckpointConfig, RunConfig
+    from ray_tpu.tune.execution.tune_controller import TuneController
+    from ray_tpu.tune.logger import DEFAULT_CALLBACKS
+
+    exp_dir = str(tmp_path / "exp")
+    controller = TuneController(
+        Slow,
+        {"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        metric="score",
+        mode="max",
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(checkpoint_frequency=1)
+        ),
+        callbacks=[cls() for cls in DEFAULT_CALLBACKS],
+        experiment_dir=exp_dir,
+        max_concurrent_trials=2,
+    )
+    # Step until some trials have made progress, then snapshot + abandon:
+    # this is what a killed driver leaves behind.
+    import time as _time
+
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        controller.step()
+        if any(
+            t.checkpoint is not None and not t.is_finished()
+            for t in controller.trials
+        ):
+            break
+    assert not controller.is_finished(), "interrupted too late to be useful"
+    controller.save_experiment_state()
+    progressed = {
+        t.trial_id: t.checkpoint["state"]["it"]
+        for t in controller.trials
+        if t.checkpoint is not None
+    }
+    assert progressed, "no trial checkpointed before the interrupt"
+    controller.cleanup()  # the "kill": actors die, state file remains
+
+    assert tune.Tuner.can_restore(exp_dir)
+    tuner = tune.Tuner.restore(exp_dir, Slow)
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.num_errors == 0
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores == [6.0, 12.0, 18.0, 24.0]
