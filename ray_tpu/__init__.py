@@ -35,6 +35,15 @@ from ray_tpu.api import (
 )
 from ray_tpu.runtime_context import get_runtime_context
 
+
+def timeline(filename=None):
+    """Chrome-trace dump of cluster task events (ray parity: ray.timeline,
+    _private/state.py:416 chrome_tracing_dump)."""
+    from ray_tpu.util.state import timeline as _timeline
+
+    return _timeline(filename)
+
+
 __version__ = "0.1.0"
 
 

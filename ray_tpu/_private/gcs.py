@@ -781,6 +781,15 @@ class GcsServer:
     # ------------------------------------------------------------------
     # Task events (observability; ray: gcs_task_manager.h)
     # ------------------------------------------------------------------
+    async def rpc_list_objects(self, conn: Connection, p):
+        """Object directory view for the state API (centralized analog of
+        ray: dashboard/state_aggregator.py list_objects)."""
+        limit = (p or {}).get("limit") or 10_000
+        out = []
+        for oid, nodes in list(self.object_dir.items())[:limit]:
+            out.append({"object_id": oid.hex(), "locations": sorted(nodes)})
+        return out
+
     async def rpc_add_task_events(self, conn: Connection, p):
         self.task_events.extend(p["events"])
         overflow = len(self.task_events) - cfg.task_events_buffer_size

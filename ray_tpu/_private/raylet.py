@@ -194,6 +194,11 @@ class Raylet:
         self.port = None
         # metrics
         self.counters = {"tasks_dispatched": 0, "tasks_spilled": 0, "objects_pulled": 0}
+        # Task state-transition buffer, flushed in batches to the GCS
+        # (ray: src/ray/core_worker/task_event_buffer.h:199 — we buffer at
+        # the raylet, the chokepoint that sees queue/dispatch/finish for
+        # every normal task on this node).
+        self._task_events: List[dict] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -210,8 +215,41 @@ class Raylet:
         self._tasks.append(
             asyncio.get_running_loop().create_task(self._memory_monitor_loop())
         )
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(self._task_event_flush_loop())
+        )
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
+
+    # ------------------------------------------------------------------
+    # task events (observability; ray: task_event_buffer.h:199)
+    # ------------------------------------------------------------------
+    def _emit_task_event(self, spec: TaskSpec, state: str, **extra):
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "job_id": spec.job_id.hex() if spec.job_id else None,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "attempt": spec.attempt,
+            "state": state,
+            "ts": time.time(),
+            "node_id": self.node_id,
+        }
+        ev.update(extra)
+        self._task_events.append(ev)
+
+    async def _task_event_flush_loop(self):
+        while True:
+            await asyncio.sleep(cfg.metrics_report_interval_s)
+            if not self._task_events:
+                continue
+            batch, self._task_events = self._task_events, []
+            try:
+                await self.gcs.request("add_task_events", {"events": batch})
+            except Exception:
+                # GCS unreachable: requeue a bounded amount.
+                batch.extend(self._task_events)
+                self._task_events = batch[-cfg.task_events_buffer_size:]
 
     # ------------------------------------------------------------------
     # OOM defense (ray: common/memory_monitor.h:52 MemoryMonitor +
@@ -519,11 +557,14 @@ class Raylet:
         if missing:
             qt.pending_deps = set(missing)
             self.waiting[spec.task_id] = qt
+            self._emit_task_event(spec, "PENDING_ARGS_FETCH",
+                                  missing=len(missing))
             for oid in missing:
                 self.dep_waiters.setdefault(oid, []).append(spec.task_id)
                 asyncio.get_running_loop().create_task(self._pull_for_dep(oid))
         else:
             self.ready.append(qt)
+            self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
             self._dispatch_event.set()
 
     def _missing_deps(self, spec: TaskSpec) -> List[bytes]:
@@ -596,6 +637,7 @@ class Raylet:
         await self._schedule_or_queue(spec, depth=0)
 
     async def _run_on_worker(self, qt: _QueuedTask, w: _Worker):
+        self._emit_task_event(qt.spec, "RUNNING", pid=w.proc.pid)
         try:
             result = await w.conn.request("execute_task", {"spec": qt.spec})
         except Exception as e:
@@ -613,6 +655,12 @@ class Raylet:
             return
         if w.actor_id is None and not w.conn.closed:
             self._return_worker(w)
+        if result.get("error") is not None:
+            self._emit_task_event(qt.spec, "FAILED", pid=w.proc.pid,
+                                  error=str(result.get("error"))[:200])
+        else:
+            self._emit_task_event(qt.spec, "FINISHED", pid=w.proc.pid,
+                                  duration=result.get("duration"))
         await self._deliver_result(qt.spec, result)
         self._dispatch_event.set()
 

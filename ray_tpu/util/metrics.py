@@ -1,0 +1,190 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+ray parity: python/ray/util/metrics (backed by the C++ OpenCensus stack,
+src/ray/stats/metric_defs.h, scraped by the per-node metrics agent). Here
+each process buffers recordings and a daemon flusher publishes them to the
+GCS KV under the "metrics" namespace; ``list_metrics()`` aggregates across
+processes. No Prometheus dependency is baked in — the KV dump is the
+scrape surface (one JSON-able dict per (metric, process)).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_KV_NS = b"metrics"
+_registry: List["Metric"] = []
+_flusher_started = False
+_flush_lock = threading.Lock()
+
+
+def _start_flusher():
+    global _flusher_started
+    with _flush_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        while True:
+            time.sleep(cfg.metrics_report_interval_s)
+            try:
+                flush()
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, name="metrics-flush", daemon=True).start()
+
+
+def flush():
+    """Publish every registered metric's current state to the GCS KV."""
+    from ray_tpu._private.worker import global_worker
+
+    if global_worker.core_worker is None:
+        return
+    cw = global_worker.core_worker
+    for metric in list(_registry):
+        record = metric._dump()
+        key = f"{metric.name}|{cw.client_id}".encode()
+        cw.io.run(cw.gcs.request(
+            "kv_put",
+            {"ns": _KV_NS, "key": key, "value": pickle.dumps(record)},
+        ))
+
+
+def list_metrics() -> Dict[str, List[dict]]:
+    """All published metric records, grouped by metric name."""
+    from ray_tpu._private.worker import global_worker
+
+    global_worker.check_connected()
+    cw = global_worker.core_worker
+    keys = cw.io.run(cw.gcs.request("kv_keys", {"ns": _KV_NS, "prefix": b""}))
+    out: Dict[str, List[dict]] = {}
+    for key in keys:
+        blob = cw.io.run(cw.gcs.request("kv_get", {"ns": _KV_NS, "key": key}))
+        if blob is None:
+            continue
+        record = pickle.loads(blob)
+        out.setdefault(record["name"], []).append(record)
+    return out
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        _registry.append(self)
+        _start_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        unknown = set(merged) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(
+                f"unknown tag keys {sorted(unknown)}; declared {self._tag_keys}"
+            )
+        return tuple(sorted(merged.items()))
+
+    def _dump(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing count (ray parity: util/metrics Counter)."""
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value < 0:
+            raise ValueError("Counter can only increase")
+        key = self._tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _dump(self):
+        with self._lock:
+            series = [
+                {"tags": dict(k), "value": v} for k, v in self._values.items()
+            ]
+        return {"name": self.name, "type": "counter",
+                "description": self.description, "series": series,
+                "ts": time.time()}
+
+
+class Gauge(Metric):
+    """Point-in-time value (ray parity: util/metrics Gauge)."""
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            self._values[self._tags(tags)] = float(value)
+
+    def _dump(self):
+        with self._lock:
+            series = [
+                {"tags": dict(k), "value": v} for k, v in self._values.items()
+            ]
+        return {"name": self.name, "type": "gauge",
+                "description": self.description, "series": series,
+                "ts": time.time()}
+
+
+class Histogram(Metric):
+    """Bucketed distribution (ray parity: util/metrics Histogram)."""
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        key = self._tags(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1)
+            )
+            idx = 0
+            while idx < len(self.boundaries) and value > self.boundaries[idx]:
+                idx += 1
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _dump(self):
+        with self._lock:
+            series = [
+                {
+                    "tags": dict(k),
+                    "buckets": list(v),
+                    "boundaries": self.boundaries,
+                    "sum": self._sums.get(k, 0.0),
+                    "count": self._totals.get(k, 0),
+                }
+                for k, v in self._counts.items()
+            ]
+        return {"name": self.name, "type": "histogram",
+                "description": self.description, "series": series,
+                "ts": time.time()}

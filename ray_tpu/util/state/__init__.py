@@ -1,0 +1,193 @@
+"""State API: programmatic cluster introspection.
+
+ray parity: python/ray/util/state/api.py (`ray.util.state.list_actors/
+list_tasks/list_nodes/list_objects/...`, aggregation in
+dashboard/state_aggregator.py:141 StateAPIManager). TPU-native the sources
+are the GCS tables (actors, nodes, jobs, placement groups, task events,
+object directory) plus per-raylet node stats — there is no separate
+aggregator process; the driver queries the GCS over its existing
+connection.
+
+Every ``list_*`` accepts ``filters`` as an iterable of ``(key, "=", value)``
+(or ``(key, "!=", value)``) tuples and a ``limit``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+__all__ = [
+    "list_actors",
+    "list_tasks",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_jobs",
+    "list_workers",
+    "summarize_tasks",
+    "get_node_stats",
+    "timeline",
+]
+
+
+def _gcs_request(method: str, payload=None):
+    from ray_tpu._private.worker import global_worker
+
+    global_worker.check_connected()
+    cw = global_worker.core_worker
+    return cw.io.run(cw.gcs.request(method, payload or {}))
+
+
+def _apply_filters(rows: List[dict], filters, limit: Optional[int]):
+    for key, op, value in filters or ():
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r} (=, !=)")
+    return rows[: limit or len(rows)]
+
+
+def _hexify(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, bytes):
+            v = v.hex()
+        out[k] = v
+    return out
+
+
+def list_actors(filters: Optional[Iterable[Tuple]] = None,
+                limit: Optional[int] = None) -> List[dict]:
+    rows = [_hexify(r) for r in _gcs_request("list_actors")]
+    return _apply_filters(rows, filters, limit)
+
+
+def list_tasks(filters: Optional[Iterable[Tuple]] = None,
+               limit: Optional[int] = None) -> List[dict]:
+    """Latest known state per task, derived from the task-event log
+    (ray parity: `ray list tasks` via gcs_task_manager.h)."""
+    events = _gcs_request("list_task_events", {"limit": 100_000})
+    latest: dict = {}
+    for ev in events:
+        key = (ev["task_id"], ev.get("attempt", 0))
+        cur = latest.get(key)
+        if cur is None or ev["ts"] >= cur["ts"]:
+            latest[key] = ev
+    rows = sorted(latest.values(), key=lambda e: e["ts"])
+    return _apply_filters(rows, filters, limit)
+
+
+def list_task_events(limit: Optional[int] = None) -> List[dict]:
+    return _gcs_request("list_task_events", {"limit": limit or 10_000})
+
+
+def list_nodes(filters: Optional[Iterable[Tuple]] = None,
+               limit: Optional[int] = None) -> List[dict]:
+    return _apply_filters(_gcs_request("get_nodes"), filters, limit)
+
+
+def list_objects(filters: Optional[Iterable[Tuple]] = None,
+                 limit: Optional[int] = None) -> List[dict]:
+    return _apply_filters(
+        _gcs_request("list_objects", {"limit": limit}), filters, limit
+    )
+
+
+def list_placement_groups(filters: Optional[Iterable[Tuple]] = None,
+                          limit: Optional[int] = None) -> List[dict]:
+    return _apply_filters(_gcs_request("pg_table", {}), filters, limit)
+
+
+def list_jobs(filters: Optional[Iterable[Tuple]] = None,
+              limit: Optional[int] = None) -> List[dict]:
+    rows = [_hexify(r) for r in _gcs_request("list_jobs")]
+    return _apply_filters(rows, filters, limit)
+
+
+def list_workers(filters: Optional[Iterable[Tuple]] = None,
+                 limit: Optional[int] = None) -> List[dict]:
+    """Per-node worker counts (from raylet node stats)."""
+    rows = []
+    for node in _gcs_request("get_nodes"):
+        if not node.get("alive"):
+            continue
+        stats = get_node_stats(node["node_id"])
+        if stats is not None:
+            rows.append(stats)
+    return _apply_filters(rows, filters, limit)
+
+
+def get_node_stats(node_id: str) -> Optional[dict]:
+    from ray_tpu._private.rpcio import EventLoopThread, connect
+    from ray_tpu._private.worker import global_worker
+
+    global_worker.check_connected()
+    cw = global_worker.core_worker
+    for node in _gcs_request("get_nodes"):
+        if node["node_id"] == node_id:
+            io = EventLoopThread("state-probe")
+            try:
+                conn = io.run(connect(node["host"], node["port"], retries=2))
+                stats = io.run(conn.request("node_stats", {}))
+                io.run(conn.close())
+                return stats
+            except Exception:
+                return None
+            finally:
+                io.stop()
+    return None
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — ray parity: `ray summary tasks`."""
+    summary: dict = {}
+    for row in list_tasks():
+        entry = summary.setdefault(
+            row["name"], {"FINISHED": 0, "FAILED": 0, "RUNNING": 0,
+                          "PENDING": 0, "total": 0}
+        )
+        state = row["state"]
+        if state.startswith("PENDING"):
+            entry["PENDING"] += 1
+        elif state in entry:
+            entry[state] += 1
+        entry["total"] += 1
+    return summary
+
+
+def timeline(filename: Optional[str] = None) -> list:
+    """Chrome-trace dump of the task-event log (ray parity:
+    `ray timeline` — _private/state.py:416 chrome_tracing_dump). Load the
+    output in chrome://tracing or Perfetto."""
+    import json
+
+    events = _gcs_request("list_task_events", {"limit": 100_000})
+    # Pair RUNNING -> FINISHED/FAILED into complete ("X") slices.
+    running: dict = {}
+    trace = []
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        key = (ev["task_id"], ev.get("attempt", 0))
+        if ev["state"] == "RUNNING":
+            running[key] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and key in running:
+            start = running.pop(key)
+            trace.append({
+                "name": ev["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": start["ts"] * 1e6,
+                "dur": max((ev["ts"] - start["ts"]) * 1e6, 1.0),
+                "pid": ev["node_id"][:8],
+                "tid": ev.get("pid", 0),
+                "args": {
+                    "task_id": ev["task_id"],
+                    "state": ev["state"],
+                    "attempt": ev.get("attempt", 0),
+                },
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
