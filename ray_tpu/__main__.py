@@ -1,0 +1,6 @@
+"""``python -m ray_tpu`` CLI entrypoint (ray parity: the `ray` console
+script, python/ray/scripts/scripts.py)."""
+
+from ray_tpu.scripts.cli import main
+
+main()

@@ -690,13 +690,14 @@ class CoreWorker:
         return None
 
     async def _gcs_reconnect_loop(self):
-        deadline = (
-            asyncio.get_running_loop().time()
-            + cfg.gcs_client_reconnect_timeout_s
-        )
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # interpreter teardown: the io loop is already gone
+        deadline = loop.time() + cfg.gcs_client_reconnect_timeout_s
         delay = 0.2
         while getattr(self, "connected", False):
-            if asyncio.get_running_loop().time() > deadline:
+            if loop.time() > deadline:
                 logger.error("GCS unreachable for %.0fs; giving up",
                              cfg.gcs_client_reconnect_timeout_s)
                 return

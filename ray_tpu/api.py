@@ -8,6 +8,7 @@ actor.py) on top of the TPU-native runtime.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -70,6 +71,15 @@ def init(
             cfg.update(_system_config)
         if object_store_memory:
             cfg.update({"object_store_memory": object_store_memory})
+        if address == "auto":
+            # Inside a cluster (worker/job-entrypoint subprocess): the
+            # raylet stamps the GCS address into the env (ray parity:
+            # RAY_ADDRESS/auto-discovery).
+            address = os.environ.get("RAY_TPU_GCS_ADDR")
+            if not address:
+                raise ConnectionError(
+                    "address='auto' but RAY_TPU_GCS_ADDR is not set"
+                )
         if address is None:
             res = dict(resources or {})
             if num_cpus is not None:

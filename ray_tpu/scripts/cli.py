@@ -1,0 +1,248 @@
+"""Command-line interface: start/stop/status/submit/list/logs/timeline.
+
+ray parity: python/ray/scripts/scripts.py (`ray start --head`,
+`ray start --address`, `ray stop`, `ray status`, `ray job submit`,
+`ray timeline`). Invoked as ``python -m ray_tpu <command>``.
+
+`start --head` spawns the GCS + a raylet detached and records the cluster
+in a state file (~/.ray_tpu/cluster.json) so later commands find it;
+`start --address host:port` joins an existing cluster with a local raylet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+STATE_DIR = os.path.expanduser("~/.ray_tpu")
+STATE_FILE = os.path.join(STATE_DIR, "cluster.json")
+
+
+def _save_state(state: dict):
+    os.makedirs(STATE_DIR, exist_ok=True)
+    with open(STATE_FILE, "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_GCS_ADDR")
+    if not addr:
+        addr = _load_state().get("address")
+    if not addr:
+        sys.exit("no cluster address: pass --address, set RAY_TPU_GCS_ADDR, "
+                 "or run `ray_tpu start --head` on this machine first")
+    return addr
+
+
+def cmd_start(args):
+    from ray_tpu._private.node import NodeProcesses
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    if args.head:
+        node = NodeProcesses(head=True, resources=resources or None)
+        state = {
+            "address": node.address,
+            "session_dir": node.session_dir,
+            "pids": [node.gcs_proc.pid, node.raylet_proc.pid],
+            "started_at": time.time(),
+        }
+        _save_state(state)
+        print(f"started head node: address={node.address}")
+        print(f"session dir: {node.session_dir}")
+        print("connect drivers with "
+              f"ray_tpu.init(address=\"{node.address}\")")
+    else:
+        address = _resolve_address(args)
+        host, port = address.rsplit(":", 1)
+        node = NodeProcesses(
+            head=False, gcs_host=host, gcs_port=int(port),
+            session_dir=args.session_dir, resources=resources or None,
+        )
+        state = _load_state()
+        state.setdefault("worker_pids", []).append(node.raylet_proc.pid)
+        _save_state(state)
+        print(f"started worker raylet joining {address} "
+              f"(node {node.node_id and node.node_id[:8]})")
+
+
+def cmd_stop(args):
+    state = _load_state()
+    pids = state.get("pids", []) + state.get("worker_pids", [])
+    killed = 0
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed += 1
+        except OSError:
+            pass
+    # Worker processes are children of raylets and exit with them; sweep
+    # stragglers of this session.
+    session = state.get("session_dir", "")
+    if session:
+        import subprocess
+
+        subprocess.run(
+            ["pkill", "-f", f"ray_tpu._private.*{os.path.basename(session)}"],
+            check=False,
+        )
+    try:
+        os.unlink(STATE_FILE)
+    except OSError:
+        pass
+    print(f"stopped {killed} processes")
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    address = _resolve_address(args)
+    ray_tpu.init(address=address, namespace="_cli")
+    nodes = ray_tpu.nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"cluster at {address}: "
+          f"{sum(1 for n in nodes if n['alive'])}/{len(nodes)} nodes alive")
+    for n in nodes:
+        mark = "+" if n["alive"] else "-"
+        print(f"  {mark} {n['node_id'][:12]} {n['host']}:{n['port']} "
+              f"{n['resources_total']}")
+    print(f"resources: {avail} available of {total}")
+    ray_tpu.shutdown()
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    address = _resolve_address(args)
+    client = JobSubmissionClient(address)
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    import shlex
+
+    if not args.entrypoint:
+        sys.exit("no entrypoint given: ray_tpu submit -- <command> [args...]")
+    entrypoint = shlex.join(args.entrypoint)
+    sid = client.submit_job(
+        entrypoint=entrypoint, runtime_env=runtime_env or None,
+        submission_id=args.submission_id,
+    )
+    print(f"submitted job {sid}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finished(sid, timeout=args.timeout)
+    print(client.get_job_logs(sid), end="")
+    print(f"job {sid}: {status}")
+    if status != "SUCCEEDED":
+        sys.exit(1)
+
+
+def cmd_job_list(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    for job in client.list_jobs():
+        print(f"{job['submission_id']}  {job['status']:10s}  "
+              f"{job['entrypoint']}")
+
+
+def cmd_job_logs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    print(client.get_job_logs(args.submission_id), end="")
+
+
+def cmd_job_stop(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    ok = client.stop_job(args.submission_id)
+    print("stopped" if ok else "not running")
+
+
+def cmd_timeline(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    path = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
+    events = ray_tpu.timeline(path)
+    print(f"wrote {len(events)} trace events to {path}")
+    ray_tpu.shutdown()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address to join (worker mode)")
+    p.add_argument("--num-cpus", type=float)
+    p.add_argument("--num-tpus", type=float)
+    p.add_argument("--resources", help="JSON resource dict")
+    p.add_argument("--session-dir")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the local cluster")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="show cluster nodes + resources")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job (shell entrypoint)")
+    p.add_argument("--address")
+    p.add_argument("--working-dir")
+    p.add_argument("--submission-id")
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command to run (prefix with --)")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("job", help="job inspection")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    jp = jsub.add_parser("list")
+    jp.add_argument("--address")
+    jp.set_defaults(fn=cmd_job_list)
+    jp = jsub.add_parser("logs")
+    jp.add_argument("submission_id")
+    jp.add_argument("--address")
+    jp.set_defaults(fn=cmd_job_logs)
+    jp = jsub.add_parser("stop")
+    jp.add_argument("submission_id")
+    jp.add_argument("--address")
+    jp.set_defaults(fn=cmd_job_stop)
+
+    p = sub.add_parser("timeline", help="dump chrome trace of task events")
+    p.add_argument("--address")
+    p.add_argument("-o", "--output")
+    p.set_defaults(fn=cmd_timeline)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

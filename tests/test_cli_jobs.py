@@ -1,0 +1,101 @@
+"""CLI + job submission end-to-end (no pytest cluster fixtures: the CLI
+starts its own cluster from the shell, the way a user would).
+
+ray parity: `ray start --head` / `ray status` / `ray job submit`
+(python/ray/scripts/scripts.py, dashboard/modules/job/job_manager.py:516).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobSubmissionClient
+
+
+def _cli(args, env, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def test_cli_start_status_submit_stop(tmp_path):
+    env = dict(os.environ)
+    env["HOME"] = str(tmp_path)  # isolate ~/.ray_tpu state
+    from ray_tpu._private.node import package_env
+
+    env = package_env(env)
+
+    out = _cli(["start", "--head", "--num-cpus", "2"], env)
+    assert out.returncode == 0, out.stderr
+    assert "started head node" in out.stdout
+    address = out.stdout.split("address=")[1].splitlines()[0].strip()
+
+    try:
+        out = _cli(["status"], env)
+        assert out.returncode == 0, out.stderr
+        assert "1/1 nodes alive" in out.stdout
+
+        out = _cli(
+            ["submit", "--timeout", "120", "--",
+             "python", "-c", "print('job says hello')"],
+            env,
+        )
+        assert out.returncode == 0, out.stderr + out.stdout
+        assert "job says hello" in out.stdout
+        assert "SUCCEEDED" in out.stdout
+
+        # failing entrypoint -> nonzero exit + FAILED
+        out = _cli(
+            ["submit", "--timeout", "120", "--",
+             "python", "-c", "raise SystemExit(3)"],
+            env,
+        )
+        assert out.returncode == 1
+        assert "FAILED" in out.stdout
+
+        out = _cli(["job", "list"], env)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.count("raysubmit_") == 2
+    finally:
+        out = _cli(["stop"], env)
+    assert out.returncode == 0, out.stderr
+    assert "stopped" in out.stdout
+
+
+def test_job_client_python_api(ray_start_cluster):
+    """JobSubmissionClient against a cluster_utils cluster: submit, poll,
+    logs, stop — including a job that connects back into the cluster with
+    address='auto'."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    client = JobSubmissionClient(cluster.address)
+    script = (
+        "import ray_tpu; ray_tpu.init(address='auto');"
+        "print('cpus', int(ray_tpu.cluster_resources()['CPU']))"
+    )
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    status = client.wait_until_finished(sid, timeout=180)
+    logs = client.get_job_logs(sid)
+    assert status == "SUCCEEDED", logs
+    assert "cpus 3" in logs
+
+    # stop a long-running job
+    sid2 = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(300)'"
+    )
+    deadline = time.monotonic() + 60
+    while client.get_job_status(sid2) == "PENDING":
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert client.stop_job(sid2)
+    assert client.wait_until_finished(sid2, timeout=60) == "STOPPED"
+    jobs = client.list_jobs()
+    assert {j["submission_id"] for j in jobs} >= {sid, sid2}
