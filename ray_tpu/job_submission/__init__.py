@@ -258,7 +258,11 @@ class JobSubmissionClient:
             raise ValueError(f"unknown job {submission_id!r}")
         info = pickle.loads(blob)
         status = info["status"]
-        if status in (PENDING, RUNNING) and handle is not None:
+        # Reaching here means supervisor resolution or its RPC failed (a
+        # dead supervisor's name is deregistered, so fresh clients land
+        # here too). A non-terminal KV record with no reachable supervisor
+        # is a crashed job.
+        if status in (PENDING, RUNNING):
             # The supervisor is unreachable but its last word was
             # non-terminal: the actor (or its node) died mid-job. Mark the
             # job failed so pollers terminate (ray: JobManager marks jobs

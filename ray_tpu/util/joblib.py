@@ -1,0 +1,49 @@
+"""joblib backend: run sklearn/joblib parallel work on the cluster.
+
+ray parity: python/ray/util/joblib/ray_backend.py — ``register_ray()``
+then ``with joblib.parallel_backend("ray_tpu"): ...`` routes joblib batches
+through cluster tasks.
+"""
+
+from __future__ import annotations
+
+
+def register_ray():
+    """Register the "ray_tpu" joblib parallel backend."""
+    from joblib._parallel_backends import MultiprocessingBackend
+    from joblib.parallel import register_parallel_backend
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """Batches execute as cluster tasks via our multiprocessing Pool."""
+
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if n_jobs == 1:
+                return 1
+            try:
+                cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            except Exception:
+                cpus = 1
+            return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            from ray_tpu.util.multiprocessing import Pool
+
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+        def _get_pool(self):
+            return self._pool
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,247 @@
+"""Workflow: durable DAG execution with per-step checkpointing + resume.
+
+ray parity: python/ray/workflow — `workflow.run(dag)` executes a
+`ray_tpu.dag` DAG with every step's result checkpointed to storage
+(workflow_executor.py:32 WorkflowExecutor, workflow_storage.py), so a
+crashed/killed run resumes from completed steps instead of recomputing
+them. Storage is a filesystem directory (pluggable via ``storage``/the
+RAY_TPU_WORKFLOW_STORAGE env var); step identity is the DAG-structural
+hash of the node (function name + argument structure), which is stable
+across processes.
+
+API: run / run_async, resume, get_status, get_output, list_all, delete.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ray_tpu.dag import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
+
+# statuses (ray parity: workflow.WorkflowStatus)
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+
+def _storage_root(storage: Optional[str] = None) -> str:
+    root = storage or os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE",
+        os.path.expanduser("~/ray_tpu_workflows"),
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _step_id(node: DAGNode, cache: Dict[int, str]) -> str:
+    """Deterministic structural id: function/method name + the step ids /
+    repr of bound args. Stable across processes for the same DAG shape."""
+    if id(node) in cache:
+        return cache[id(node)]
+    h = hashlib.sha256()
+    if isinstance(node, FunctionNode):
+        h.update(getattr(node._fn, "__name__", "fn").encode())
+    elif isinstance(node, ClassMethodNode):
+        h.update(node._method.encode())
+        if isinstance(node._target, DAGNode):
+            h.update(_step_id(node._target, cache).encode())
+    elif isinstance(node, ClassNode):
+        h.update(getattr(node._cls, "__name__", "cls").encode())
+    elif isinstance(node, InputNode):
+        h.update(b"__input__")
+    def feed(value):
+        if isinstance(value, DAGNode):
+            h.update(_step_id(value, cache).encode())
+        else:
+            h.update(repr(value).encode())
+
+    for a in node._bound_args:
+        feed(a)
+    for k in sorted(node._bound_kwargs):
+        h.update(k.encode())
+        feed(node._bound_kwargs[k])
+    sid = h.hexdigest()[:16]
+    cache[id(node)] = sid
+    return sid
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, storage: Optional[str]):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_storage_root(storage), workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- metadata ------------------------------------------------------
+    def _meta_path(self):
+        return os.path.join(self.dir, "meta.pkl")
+
+    def write_meta(self, **kw):
+        meta = self.read_meta()
+        meta.update(kw, ts=time.time())
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(meta, f)
+        os.replace(tmp, self._meta_path())
+
+    def read_meta(self) -> dict:
+        try:
+            with open(self._meta_path(), "rb") as f:
+                return pickle.load(f)
+        except (OSError, EOFError):
+            return {"workflow_id": self.workflow_id}
+
+    # -- step checkpoints ---------------------------------------------
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, f"step_{step_id}.pkl")
+
+    def load_step(self, step_id: str):
+        path = self.step_path(step_id)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return None
+
+    def save_step(self, step_id: str, value: Any):
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"value": value}, f, protocol=5)
+        os.replace(tmp, self.step_path(step_id))
+
+    # -- execution -----------------------------------------------------
+    def execute(self, node: DAGNode, dag_input: Any = None) -> Any:
+        """Walk the DAG: checkpointed steps are skipped, others submit as
+        cluster tasks whose results checkpoint on completion."""
+        import ray_tpu
+
+        self.write_meta(status=RUNNING)
+        ids: Dict[int, str] = {}
+        memo: Dict[int, Any] = {}
+
+        def resolve(n: DAGNode):
+            if id(n) in memo:
+                return memo[id(n)]
+            if isinstance(n, InputNode):
+                memo[id(n)] = dag_input
+                return dag_input
+            sid = _step_id(n, ids)
+            # Actor handles aren't durable: ClassNode re-executes on resume.
+            if not isinstance(n, ClassNode):
+                ckpt = self.load_step(sid)
+                if ckpt is not None:
+                    memo[id(n)] = ckpt["value"]
+                    return ckpt["value"]
+            args = [resolve(a) if isinstance(a, DAGNode) else a
+                    for a in n._bound_args]
+            kwargs = {k: resolve(v) if isinstance(v, DAGNode) else v
+                      for k, v in n._bound_kwargs.items()}
+            if isinstance(n, FunctionNode):
+                value = ray_tpu.get(n._fn.remote(*args, **kwargs))
+            elif isinstance(n, ClassNode):
+                value = n._cls.remote(*args, **kwargs)
+            elif isinstance(n, ClassMethodNode):
+                target = n._target
+                if isinstance(target, DAGNode):
+                    target = resolve(target)
+                value = ray_tpu.get(
+                    getattr(target, n._method).remote(*args, **kwargs)
+                )
+            else:
+                raise TypeError(f"unsupported DAG node {type(n).__name__}")
+            if not isinstance(n, ClassNode):
+                self.save_step(sid, value)
+            memo[id(n)] = value
+            return value
+
+        try:
+            result = resolve(node)
+        except Exception as e:
+            self.write_meta(status=FAILED, error=f"{type(e).__name__}: {e}")
+            raise
+        self.write_meta(status=SUCCESSFUL)
+        self.save_step("__output__", result)
+        return result
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None, dag_input: Any = None) -> Any:
+    """Execute a DAG durably; returns the output value. If ``workflow_id``
+    names a previous (possibly crashed) run in the same storage, completed
+    steps are reused (ray parity: workflow.run)."""
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    wf = _WorkflowRun(workflow_id, storage)
+    # A DAG that already ran to completion returns its stored output.
+    out = wf.load_step("__output__")
+    if out is not None and wf.read_meta().get("status") == SUCCESSFUL:
+        return out["value"]
+    return wf.execute(dag, dag_input)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None, dag_input: Any = None):
+    """Like run() but returns a concurrent.futures.Future."""
+    import concurrent.futures
+    import threading
+
+    fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+    def worker():
+        try:
+            fut.set_result(run(dag, workflow_id=workflow_id, storage=storage,
+                               dag_input=dag_input))
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=worker, daemon=True).start()
+    return fut
+
+
+def resume(workflow_id: str, dag: DAGNode, *,
+           storage: Optional[str] = None, dag_input: Any = None) -> Any:
+    """Resume an interrupted workflow: completed steps load from storage,
+    the rest execute. The DAG must be re-supplied (code isn't persisted;
+    step identity is structural, so the same DAG maps onto its
+    checkpoints)."""
+    return run(dag, workflow_id=workflow_id, storage=storage,
+               dag_input=dag_input)
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> str:
+    meta = _WorkflowRun(workflow_id, storage).read_meta()
+    status = meta.get("status")
+    if status == RUNNING:
+        # A RUNNING record with no live process is a crashed run.
+        return RESUMABLE
+    return status or RESUMABLE
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    wf = _WorkflowRun(workflow_id, storage)
+    out = wf.load_step("__output__")
+    if out is None:
+        raise ValueError(f"workflow {workflow_id!r} has no output yet")
+    return out["value"]
+
+
+def list_all(storage: Optional[str] = None):
+    root = _storage_root(storage)
+    out = []
+    for name in sorted(os.listdir(root)):
+        if os.path.isdir(os.path.join(root, name)):
+            out.append((name, get_status(name, storage)))
+    return out
+
+
+def delete(workflow_id: str, storage: Optional[str] = None):
+    import shutil
+
+    shutil.rmtree(os.path.join(_storage_root(storage), workflow_id),
+                  ignore_errors=True)

@@ -1,0 +1,150 @@
+"""util extras: ActorPool, Queue, multiprocessing.Pool, joblib, workflow.
+
+Reference analogs: ray python/ray/tests/test_actor_pool.py, test_queue.py,
+util/multiprocessing tests, workflow/tests.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        import time as _t
+
+        _t.sleep(0.2 * x)
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(6))) == [
+        0, 2, 4, 6, 8, 10,
+    ]
+
+
+def test_actor_pool_unordered_and_queueing(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    # More submissions than actors: excess queue and drain via returns.
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(5)))
+    assert out == [0, 2, 4, 6, 8]
+    assert pool.pop_idle() is not None
+
+
+def test_queue_basics(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2 and q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+
+def test_queue_blocking_across_tasks(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = producer.remote(q, 3)
+    got = [q.get(timeout=30) for _ in range(3)]
+    assert got == [0, 1, 2]
+    assert ray_tpu.get(ref, timeout=30)
+
+
+def _sq(x):
+    return x * x
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq, range(8)) == [x * x for x in range(8)]
+        assert pool.apply(_sq, (5,)) == 25
+        r = pool.apply_async(_sq, (6,))
+        assert r.get(timeout=60) == 36
+        assert sorted(pool.imap_unordered(_sq, range(4))) == [0, 1, 4, 9]
+        assert list(pool.imap(_sq, range(4))) == [0, 1, 4, 9]
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_workflow_run_and_resume(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+
+    calls = tmp_path / "calls.txt"
+
+    @ray_tpu.remote
+    def add(a, b):
+        with open(calls, "a") as f:
+            f.write("add\n")
+        return a + b
+
+    @ray_tpu.remote
+    def boom(x):
+        raise RuntimeError("step failed")
+
+    @ray_tpu.remote
+    def double(x):
+        with open(calls, "a") as f:
+            f.write("double\n")
+        return 2 * x
+
+    storage = str(tmp_path / "wf")
+    dag = double.bind(add.bind(1, 2))
+    out = workflow.run(dag, workflow_id="wf1", storage=storage)
+    assert out == 6
+    assert workflow.get_status("wf1", storage=storage) == "SUCCESSFUL"
+    assert workflow.get_output("wf1", storage=storage) == 6
+    n_calls = len(calls.read_text().splitlines())
+    assert n_calls == 2
+
+    # Re-running the finished workflow replays from storage: no new calls.
+    assert workflow.run(dag, workflow_id="wf1", storage=storage) == 6
+    assert len(calls.read_text().splitlines()) == n_calls
+
+    # A failing workflow checkpoints its completed prefix; after the fix
+    # (new DAG tail) the prefix is reused.
+    dag2 = boom.bind(add.bind(1, 2))
+    with pytest.raises(Exception, match="step failed"):
+        workflow.run(dag2, workflow_id="wf2", storage=storage)
+    assert workflow.get_status("wf2", storage=storage) == "FAILED"
+    fixed = double.bind(add.bind(1, 2))
+    out = workflow.resume("wf2", fixed, storage=storage)
+    assert out == 6
+    # add ran once for wf2's failed attempt, double once on resume; the
+    # checkpointed add step did NOT re-execute.
+    assert len(calls.read_text().splitlines()) == n_calls + 2
+    assert ("wf1", "SUCCESSFUL") in workflow.list_all(storage=storage)
+    workflow.delete("wf1", storage=storage)
+    assert ("wf1", "SUCCESSFUL") not in workflow.list_all(storage=storage)
