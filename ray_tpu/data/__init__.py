@@ -14,10 +14,15 @@ from ray_tpu.data.read_api import (
     range_tensor,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
+    read_tfrecords,
+    read_webdataset,
 )
+from ray_tpu.data import preprocessors
 
 __all__ = [
     "Block",
@@ -34,7 +39,12 @@ __all__ = [
     "range_tensor",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
+    "read_tfrecords",
+    "read_webdataset",
+    "preprocessors",
 ]

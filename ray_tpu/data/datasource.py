@@ -213,3 +213,227 @@ def write_block_numpy(block: pa.Table, path: str, idx: int,
     out = os.path.join(path, f"part-{idx:05d}.npy")
     np.save(out, column_to_numpy(block.column(column)))
     return out
+
+
+# -- extended datasources (ray: data/datasource/{image_datasource.py,
+# tfrecords_datasource.py, webdataset_datasource.py, sql_datasource.py}) --
+
+def image_tasks(paths, parallelism: int, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False) -> List[ReadTask]:
+    """Decode images into a tensor column (ray: ImageDatasource). ``size``
+    resizes, ``mode`` converts (e.g. "RGB", "L")."""
+    files = [p for p in _expand_paths(paths)
+             if p.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif",
+                                    ".tif", ".tiff", ".webp"))]
+    if not files:
+        raise FileNotFoundError(f"no image files under {paths}")
+
+    def make(group: List[str]):
+        def read():
+            from PIL import Image
+
+            arrays, names = [], []
+            for f in group:
+                img = Image.open(f)
+                if mode is not None:
+                    img = img.convert(mode)
+                if size is not None:
+                    img = img.resize(size)
+                arrays.append(np.asarray(img))
+                names.append(f)
+            shapes = {a.shape for a in arrays}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"images under the path have mixed shapes {shapes}; "
+                    "pass size=(w, h) (and mode='RGB'/'L' for mixed color "
+                    "modes) to read_images to normalize them"
+                )
+            cols = {"image": tensor_column(np.stack(arrays))}
+            if include_paths:
+                cols["path"] = pa.array(names)
+            return pa.table(cols)
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def _read_tfrecord_frames(path: str):
+    """Yield raw record payloads from a TFRecord file. Wire format per
+    record: 8B little-endian length, 4B length-CRC, payload, 4B data-CRC
+    (CRCs unverified — malformed files surface as struct errors)."""
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            f.read(4)  # length crc
+            payload = f.read(length)
+            f.read(4)  # data crc
+            if len(payload) < length:
+                return
+            yield payload
+
+
+def _parse_tf_example(payload: bytes) -> Dict[str, Any]:
+    """Minimal tf.train.Example protobuf parser (no tensorflow dep).
+
+    Example = { features(1): Features { feature(1): map<string, Feature> }}
+    Feature = one of bytes_list(1) / float_list(2) / int64_list(3).
+    """
+    def read_varint(buf, i):
+        shift = result = 0
+        while True:
+            b = buf[i]
+            i += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result, i
+            shift += 7
+
+    def read_fields(buf):
+        i = 0
+        while i < len(buf):
+            tag, i = read_varint(buf, i)
+            field, wire = tag >> 3, tag & 7
+            if wire == 2:  # length-delimited
+                n, i = read_varint(buf, i)
+                yield field, buf[i:i + n]
+                i += n
+            elif wire == 0:
+                v, i = read_varint(buf, i)
+                yield field, v
+            elif wire == 5:
+                yield field, buf[i:i + 4]
+                i += 4
+            elif wire == 1:
+                yield field, buf[i:i + 8]
+                i += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire}")
+
+    import struct
+
+    out: Dict[str, Any] = {}
+    for f1, features in read_fields(payload):
+        if f1 != 1:
+            continue
+        for f2, entry in read_fields(features):
+            if f2 != 1:
+                continue
+            key = value = None
+            for f3, kv in read_fields(entry):
+                if f3 == 1:
+                    key = kv.decode()
+                elif f3 == 2:
+                    for f4, lst in read_fields(kv):
+                        # Repeated fields accumulate: both non-packed
+                        # encodings (one entry per value) and packed
+                        # payloads split across chunks are legal protobuf.
+                        if f4 == 1:  # bytes_list
+                            got = [v for f5, v in read_fields(lst) if f5 == 1]
+                            value = (value or []) + got
+                        elif f4 == 2:  # float_list (packed or repeated)
+                            for f5, packed in read_fields(lst):
+                                if f5 != 1:
+                                    continue
+                                if isinstance(packed, int):
+                                    got = [packed]
+                                elif len(packed) == 4:
+                                    got = [struct.unpack("<f", packed)[0]]
+                                else:
+                                    got = list(struct.unpack(
+                                        f"<{len(packed) // 4}f", packed
+                                    ))
+                                value = (value or []) + got
+                        elif f4 == 3:  # int64_list (packed or repeated)
+                            for f5, packed in read_fields(lst):
+                                if f5 != 1:
+                                    continue
+                                if isinstance(packed, int):
+                                    got = [packed]
+                                else:
+                                    got, i = [], 0
+                                    while i < len(packed):
+                                        v, i = read_varint(packed, i)
+                                        got.append(v)
+                                value = (value or []) + got
+            if key is not None and value is not None:
+                out[key] = value[0] if len(value) == 1 else value
+    return out
+
+
+def tfrecord_tasks(paths, parallelism: int) -> List[ReadTask]:
+    """Read TFRecord files of tf.train.Example protos without a tensorflow
+    dependency (ray: TFRecordDatasource)."""
+    files = _expand_paths(paths)
+
+    def make(group: List[str]):
+        def read():
+            rows = []
+            for f in group:
+                for payload in _read_tfrecord_frames(f):
+                    rows.append(_parse_tf_example(payload))
+            return rows_to_block(rows)
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def webdataset_tasks(paths, parallelism: int) -> List[ReadTask]:
+    """Read WebDataset-style tar shards: members grouped by basename stem
+    become one row with one column per extension (ray:
+    WebDatasetDatasource)."""
+    files = [p for p in _expand_paths(paths) if p.endswith(".tar")]
+    if not files:
+        raise FileNotFoundError(f"no .tar shards under {paths}")
+
+    def make(group: List[str]):
+        def read():
+            import tarfile
+
+            rows: List[Dict[str, Any]] = []
+            for shard in group:
+                samples: Dict[str, Dict[str, Any]] = {}
+                with tarfile.open(shard) as tf:
+                    for member in tf.getmembers():
+                        if not member.isfile():
+                            continue
+                        stem, _, ext = member.name.partition(".")
+                        data = tf.extractfile(member).read()
+                        if ext in ("txt", "cls", "json"):
+                            value: Any = data.decode()
+                        else:
+                            value = data
+                        samples.setdefault(stem, {"__key__": stem})[ext] = value
+                rows.extend(samples[k] for k in sorted(samples))
+            return rows_to_block(rows)
+
+        return read
+
+    return [make(g) for g in _chunk(files, parallelism)]
+
+
+def sql_tasks(sql: str, connection_factory: Callable[[], Any],
+              parallelism: int) -> List[ReadTask]:
+    """Run a SQL query through a DB-API connection factory (ray:
+    SQLDatasource). The query runs once (DB-API has no generic
+    partitioning); parallelism applies to downstream transforms."""
+
+    def read():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, row)) for row in cur.fetchall()]
+        finally:
+            conn.close()
+        return rows_to_block(rows)
+
+    return [read]

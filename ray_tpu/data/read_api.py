@@ -105,3 +105,37 @@ def from_arrow_refs(refs: List[Any]) -> Dataset:
     return Dataset.from_bundles(
         [(r, BlockMetadata.for_block(ray_tpu.get(r))) for r in refs]
     )
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None, include_paths: bool = False,
+                parallelism: int = -1, **_kw) -> Dataset:
+    """ray parity: read_images (data/datasource/image_datasource.py)."""
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(
+        ds.image_tasks(paths, p, size=size, mode=mode,
+                       include_paths=include_paths), p
+    )
+
+
+def read_tfrecords(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    """ray parity: read_tfrecords — tf.train.Example protos parsed without
+    a tensorflow dependency."""
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.tfrecord_tasks(paths, p), p)
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    """ray parity: read_webdataset — tar shards, one row per sample key."""
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(ds.webdataset_tasks(paths, p), p)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1,
+             **_kw) -> Dataset:
+    """ray parity: read_sql — any DB-API connection factory (sqlite3,
+    psycopg2, ...)."""
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(
+        ds.sql_tasks(sql, connection_factory, p), p
+    )

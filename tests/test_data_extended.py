@@ -1,0 +1,156 @@
+"""Extended datasources + preprocessors (ray parity:
+python/ray/data/tests/test_image.py, test_tfrecords.py, preprocessors)."""
+
+import os
+import sqlite3
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data import preprocessors as pp
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    from PIL import Image
+
+    for i in range(4):
+        arr = np.full((8, 8, 3), i * 10, dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+    ds = rdata.read_images(str(tmp_path), size=(4, 4), include_paths=True)
+    batch = ds.take_batch(10, batch_format="numpy")
+    assert batch["image"].shape == (4, 4, 4, 3)
+    assert all(p.endswith(".png") for p in batch["path"])
+
+
+def _write_tfrecord(path, examples):
+    """Hand-encode tf.train.Example protos + TFRecord framing."""
+
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):  # length-delimited field
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    with open(path, "wb") as f:
+        for ex in examples:
+            feats = b""
+            for key, value in ex.items():
+                if isinstance(value, bytes):
+                    flist = ld(1, ld(1, value))  # bytes_list
+                elif isinstance(value, float):
+                    flist = ld(2, ld(1, struct.pack("<f", value)))
+                else:
+                    flist = ld(3, ld(1, varint(int(value))))
+                entry = ld(1, key.encode()) + ld(2, flist)
+                feats += ld(1, entry)
+            payload = ld(1, feats)  # Example.features
+            f.write(struct.pack("<Q", len(payload)))
+            f.write(b"\x00" * 4)
+            f.write(payload)
+            f.write(b"\x00" * 4)
+
+
+def test_read_tfrecords(ray_start_regular, tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    _write_tfrecord(path, [
+        {"name": b"alice", "age": 30, "score": 1.5},
+        {"name": b"bob", "age": 25, "score": 2.5},
+    ])
+    rows = sorted(rdata.read_tfrecords(path).take_all(),
+                  key=lambda r: r["age"])
+    assert rows[0]["name"] == b"bob" and rows[0]["age"] == 25
+    assert abs(rows[1]["score"] - 1.5) < 1e-6
+
+
+def test_read_webdataset(ray_start_regular, tmp_path):
+    shard = tmp_path / "shard_0.tar"
+    with tarfile.open(shard, "w") as tf:
+        for key, cls in [("s0", "cat"), ("s1", "dog")]:
+            for ext, data in [("txt", f"text-{key}".encode()),
+                              ("cls", cls.encode())]:
+                import io
+
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    rows = rdata.read_webdataset(str(tmp_path)).take_all()
+    assert len(rows) == 2
+    assert rows[0]["__key__"] == "s0" and rows[0]["cls"] == "cat"
+    assert rows[1]["txt"] == "text-s1"
+
+
+def test_read_sql(ray_start_regular, tmp_path):
+    db = str(tmp_path / "test.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(1, "a"), (2, "b"), (3, "c")])
+    conn.commit()
+    conn.close()
+    ds = rdata.read_sql("SELECT * FROM t ORDER BY id",
+                        lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert [r["name"] for r in rows] == ["a", "b", "c"]
+
+
+def test_standard_and_minmax_scaler(ray_start_regular):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [0.0, 1.0, 2.0, 3.0], "y": [10.0, 20.0, 30.0, 40.0]})
+    ds = rdata.from_pandas(df)
+    scaler = pp.StandardScaler(["x"])
+    out = scaler.fit_transform(ds).to_pandas().sort_values("y")
+    np.testing.assert_allclose(out["x"].mean(), 0.0, atol=1e-9)
+    np.testing.assert_allclose(out["x"].std(ddof=0), 1.0, atol=1e-9)
+
+    mm = pp.MinMaxScaler(["y"]).fit(ds)
+    out2 = mm.transform(ds).to_pandas()
+    assert out2["y"].min() == 0.0 and out2["y"].max() == 1.0
+    # serving-time single batch
+    served = mm.transform_batch({"x": [9.9], "y": [25.0]})
+    np.testing.assert_allclose(served["y"], [0.5])
+
+
+def test_label_onehot_imputer_concat_chain(ray_start_regular):
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "cat": ["a", "b", "a", "c"],
+        "v": [1.0, np.nan, 3.0, np.nan],
+        "w": [1.0, 1.0, 1.0, 1.0],
+    })
+    ds = rdata.from_pandas(df)
+
+    le = pp.LabelEncoder("cat").fit(ds)
+    assert sorted(le.transform(ds).to_pandas()["cat"].tolist()) == [0, 0, 1, 2]
+
+    oh = pp.OneHotEncoder(["cat"]).fit(ds)
+    out = oh.transform(ds).to_pandas()
+    assert {"cat_a", "cat_b", "cat_c"} <= set(out.columns)
+    assert out["cat_a"].sum() == 2
+
+    imp = pp.SimpleImputer(["v"], strategy="mean").fit(ds)
+    out = imp.transform(ds).to_pandas()
+    np.testing.assert_allclose(sorted(out["v"]), [1.0, 2.0, 2.0, 3.0])
+
+    chain = pp.Chain(
+        pp.SimpleImputer(["v"], strategy="constant", fill_value=0.0),
+        pp.Concatenator(["v", "w"], output_column_name="vec"),
+    )
+    out = chain.fit_transform(ds).to_pandas()
+    assert "vec" in out.columns and len(out["vec"].iloc[0]) == 2
+    served = chain.transform_batch({"cat": ["a"], "v": [np.nan], "w": [5.0]})
+    np.testing.assert_allclose(served["vec"].iloc[0], [0.0, 5.0])
+
+    with pytest.raises(pp.PreprocessorNotFittedError):
+        pp.StandardScaler(["x"]).transform(ds)
