@@ -211,19 +211,25 @@ class JobSubmissionClient:
         ))
 
     def _supervisor(self, submission_id: str):
+        """(handle_or_None, definitely_dead). A name-lookup miss is
+        authoritative (dead supervisors deregister their name); transient
+        connection errors are NOT treated as death."""
         import ray_tpu
 
         handle = self._supervisors.get(submission_id)
-        if handle is None:
-            try:
-                handle = ray_tpu.get_actor(
-                    f"_job_supervisor:{submission_id}",
-                    namespace="_job_submission",
-                )
-                self._supervisors[submission_id] = handle
-            except Exception:
-                return None
-        return handle
+        if handle is not None:
+            return handle, False
+        try:
+            handle = ray_tpu.get_actor(
+                f"_job_supervisor:{submission_id}",
+                namespace="_job_submission",
+            )
+        except ValueError:
+            return None, True
+        except Exception:
+            return None, False
+        self._supervisors[submission_id] = handle
+        return handle, False
 
     # -- API ------------------------------------------------------------
     def submit_job(self, *, entrypoint: str,
@@ -247,22 +253,29 @@ class JobSubmissionClient:
 
         import ray_tpu
 
-        handle = self._supervisor(submission_id)
+        handle, dead = self._supervisor(submission_id)
         if handle is not None:
             try:
                 return ray_tpu.get(handle.status.remote(), timeout=30)
             except Exception:
+                # Stale handle (supervisor exited after finishing, or died):
+                # re-resolve by name for the authoritative answer.
                 self._supervisors.pop(submission_id, None)
+                handle, dead = self._supervisor(submission_id)
+                if handle is not None:
+                    try:
+                        return ray_tpu.get(handle.status.remote(), timeout=30)
+                    except Exception:
+                        pass
         blob = self._kv_get(f"info:{submission_id}")
         if blob is None:
             raise ValueError(f"unknown job {submission_id!r}")
         info = pickle.loads(blob)
         status = info["status"]
-        # Reaching here means supervisor resolution or its RPC failed (a
-        # dead supervisor's name is deregistered, so fresh clients land
-        # here too). A non-terminal KV record with no reachable supervisor
-        # is a crashed job.
-        if status in (PENDING, RUNNING):
+        # A non-terminal KV record whose supervisor name no longer resolves
+        # is a crashed job (dead supervisors deregister). Transient lookup
+        # errors leave the recorded status untouched.
+        if status in (PENDING, RUNNING) and dead:
             # The supervisor is unreachable but its last word was
             # non-terminal: the actor (or its node) died mid-job. Mark the
             # job failed so pollers terminate (ray: JobManager marks jobs
@@ -294,7 +307,7 @@ class JobSubmissionClient:
     def get_job_logs(self, submission_id: str) -> str:
         import ray_tpu
 
-        handle = self._supervisor(submission_id)
+        handle, _ = self._supervisor(submission_id)
         if handle is not None:
             try:
                 return ray_tpu.get(
@@ -308,7 +321,7 @@ class JobSubmissionClient:
     def stop_job(self, submission_id: str) -> bool:
         import ray_tpu
 
-        handle = self._supervisor(submission_id)
+        handle, _ = self._supervisor(submission_id)
         if handle is None:
             return False
         try:

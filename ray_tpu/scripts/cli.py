@@ -187,6 +187,34 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_serve_deploy(args):
+    """ray parity: `serve deploy config.yaml` (REST path collapsed to a
+    direct client call)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    with open(args.config) as f:
+        config = json.load(f)
+    ray_tpu.init(address=_resolve_address(args), namespace="serve",
+                 ignore_reinit_error=True)
+    deployed = serve.deploy_config(config)
+    print(f"deployed applications: {', '.join(deployed)}")
+
+
+def cmd_serve_status(args):
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=_resolve_address(args), namespace="serve",
+                 ignore_reinit_error=True)
+    status = serve.status()
+    if not status:
+        print("no Serve applications")
+        return
+    for app, info in status.items():
+        print(f"{app}: {info}")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI"
@@ -237,6 +265,16 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("-o", "--output")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("serve", help="declarative Serve deploy/status")
+    ssub = p.add_subparsers(dest="serve_command", required=True)
+    sp = ssub.add_parser("deploy")
+    sp.add_argument("config", help="JSON config file (ServeDeploySchema)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_deploy)
+    sp = ssub.add_parser("status")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_status)
 
     args = parser.parse_args(argv)
     if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":

@@ -15,6 +15,13 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import Application, Deployment, deployment, ingress
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.schema import (
+    build,
+    deploy_config,
+    get_deployed_config,
+    ServeApplicationSchema,
+    ServeDeploySchema,
+)
 
 __all__ = [
     "Application",

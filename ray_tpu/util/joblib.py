@@ -27,7 +27,11 @@ def register_ray():
                 cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
             except Exception:
                 cpus = 1
-            return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+            if n_jobs is None or n_jobs == -1:
+                return max(cpus, 1)
+            if n_jobs < 0:  # joblib idiom: -2 means all-but-one, etc.
+                return max(cpus + 1 + n_jobs, 1)
+            return min(n_jobs, cpus)
 
         def configure(self, n_jobs=1, parallel=None, prefer=None,
                       require=None, **kwargs):

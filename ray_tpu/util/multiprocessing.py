@@ -14,10 +14,14 @@ from typing import Any, Callable, Iterable, List, Optional
 
 
 class _PoolActor:
-    def run_batch(self, fn_and_items):
-        fn, items = fn_and_items
-        return [fn(*args) if isinstance(args, tuple) else fn(args)
-                for args in items]
+    def run_batch(self, fn_items_star):
+        """star=True unpacks each item as *args (starmap/apply); star=False
+        passes the item as the single argument (map semantics — a tuple
+        item stays one argument, matching stdlib Pool)."""
+        fn, items, star = fn_items_star
+        if star:
+            return [fn(*args) for args in items]
+        return [fn(item) for item in items]
 
 
 class AsyncResult:
@@ -74,7 +78,7 @@ class Pool:
             refs = []
             for a in self._actors:
                 refs.append(
-                    a.run_batch.remote((lambda *_: initializer(*initargs), [()]))
+                    a.run_batch.remote((lambda: initializer(*initargs), [()], True))
                 )
             ray_tpu.get(refs, timeout=120)
 
@@ -88,11 +92,12 @@ class Pool:
             chunksize = max(1, len(items) // (self._size * 4) or 1)
         return [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
 
-    def _submit(self, fn: Callable, chunks: List[list]) -> AsyncResult:
+    def _submit(self, fn: Callable, chunks: List[list],
+                star: bool = False) -> AsyncResult:
         refs = []
         for i, chunk in enumerate(chunks):
             actor = self._actors[i % self._size]
-            refs.append(actor.run_batch.remote((fn, chunk)))
+            refs.append(actor.run_batch.remote((fn, chunk, star)))
         return AsyncResult(refs, [len(c) for c in chunks])
 
     # -- API -----------------------------------------------------------
@@ -105,7 +110,7 @@ class Pool:
         self._check_open()
         kwds = kwds or {}
         call = (lambda *a: fn(*a, **kwds)) if kwds else fn
-        res = self._submit(call, [[tuple(args)]])
+        res = self._submit(call, [[tuple(args)]], star=True)
         res._single = True
         if callback is not None or error_callback is not None:
             import threading
@@ -136,7 +141,7 @@ class Pool:
                 chunksize: Optional[int] = None) -> List[Any]:
         self._check_open()
         chunks = self._chunked([tuple(t) for t in iterable], chunksize)
-        return self._submit(fn, chunks).get()
+        return self._submit(fn, chunks, star=True).get()
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
@@ -144,7 +149,7 @@ class Pool:
 
         self._check_open()
         chunks = self._chunked(iterable, chunksize or 1)
-        refs = [self._actors[i % self._size].run_batch.remote((fn, c))
+        refs = [self._actors[i % self._size].run_batch.remote((fn, c, False))
                 for i, c in enumerate(chunks)]
         for ref in refs:  # submission order
             yield from ray_tpu.get(ref)
@@ -156,7 +161,7 @@ class Pool:
         self._check_open()
         chunks = self._chunked(iterable, chunksize or 1)
         pending = {
-            self._actors[i % self._size].run_batch.remote((fn, c))
+            self._actors[i % self._size].run_batch.remote((fn, c, False))
             for i, c in enumerate(chunks)
         }
         while pending:

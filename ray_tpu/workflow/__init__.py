@@ -41,7 +41,10 @@ def _storage_root(storage: Optional[str] = None) -> str:
 
 def _step_id(node: DAGNode, cache: Dict[int, str]) -> str:
     """Deterministic structural id: function/method name + the step ids /
-    repr of bound args. Stable across processes for the same DAG shape."""
+    repr of bound args, disambiguated by occurrence number so two sibling
+    calls with identical signatures get distinct checkpoints (ray gives
+    each bind a unique step id). Stable across processes because DAG
+    traversal order is deterministic."""
     if id(node) in cache:
         return cache[id(node)]
     h = hashlib.sha256()
@@ -66,7 +69,11 @@ def _step_id(node: DAGNode, cache: Dict[int, str]) -> str:
     for k in sorted(node._bound_kwargs):
         h.update(k.encode())
         feed(node._bound_kwargs[k])
-    sid = h.hexdigest()[:16]
+    base = h.hexdigest()[:16]
+    counts = cache.setdefault("__counts__", {})
+    k = counts.get(base, 0)
+    counts[base] = k + 1
+    sid = base if k == 0 else f"{base}-{k}"
     cache[id(node)] = sid
     return sid
 
@@ -122,7 +129,8 @@ class _WorkflowRun:
         cluster tasks whose results checkpoint on completion."""
         import ray_tpu
 
-        self.write_meta(status=RUNNING)
+        self.write_meta(status=RUNNING, owner_pid=os.getpid(),
+                        owner_host=os.uname().nodename)
         ids: Dict[int, str] = {}
         memo: Dict[int, Any] = {}
 
@@ -218,7 +226,16 @@ def get_status(workflow_id: str, storage: Optional[str] = None) -> str:
     meta = _WorkflowRun(workflow_id, storage).read_meta()
     status = meta.get("status")
     if status == RUNNING:
-        # A RUNNING record with no live process is a crashed run.
+        # Only a RUNNING record whose owner process is gone is a crashed
+        # (resumable) run; a live owner is genuinely still executing.
+        pid = meta.get("owner_pid")
+        same_host = meta.get("owner_host") == os.uname().nodename
+        if pid and same_host:
+            try:
+                os.kill(pid, 0)
+                return RUNNING
+            except OSError:
+                return RESUMABLE
         return RESUMABLE
     return status or RESUMABLE
 

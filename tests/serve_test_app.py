@@ -1,0 +1,17 @@
+"""Importable Serve application for declarative-deploy tests (the
+`import_path` target, like the reference's test config modules)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Echo:
+    def __call__(self, request):
+        return {"echo": getattr(request, "query", {}).get("m", "none")}
+
+
+app = Echo.bind()
+
+
+def app_builder():
+    return Echo.options(name="BuiltEcho").bind()

@@ -148,3 +148,42 @@ def test_workflow_run_and_resume(ray_start_regular, tmp_path):
     assert ("wf1", "SUCCESSFUL") in workflow.list_all(storage=storage)
     workflow.delete("wf1", storage=storage)
     assert ("wf1", "SUCCESSFUL") not in workflow.list_all(storage=storage)
+
+
+def _first(t):
+    return t[0]
+
+
+def test_multiprocessing_pool_tuple_items(ray_start_regular):
+    """map passes each item as ONE argument (stdlib contract): tuple items
+    must not be star-unpacked."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(sum, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.map(_first, [(1, 2), (3, 4)]) == [1, 3]
+        assert list(pool.imap(_first, [(9, 0)])) == [9]
+
+
+def test_workflow_distinct_sibling_steps(ray_start_regular, tmp_path):
+    """Two binds with identical signatures are distinct steps, each
+    executed once (no checkpoint collapse)."""
+    from ray_tpu import workflow
+
+    marker = tmp_path / "runs.txt"
+
+    @ray_tpu.remote
+    def sample():
+        with open(marker, "a") as f:
+            f.write("x\n")
+        return 1
+
+    @ray_tpu.remote
+    def combine(a, b):
+        return a + b
+
+    dag = combine.bind(sample.bind(), sample.bind())
+    out = workflow.run(dag, workflow_id="wf_sib",
+                       storage=str(tmp_path / "wf"))
+    assert out == 2
+    assert len(marker.read_text().splitlines()) == 2
