@@ -74,6 +74,10 @@ class ObjectRef:
         return (_rebuild_ref, (self._id.binary(), self._owner))
 
     def __del__(self):
+        # NEVER release synchronously: __del__ runs at arbitrary GC points,
+        # including inside core-worker sections that already hold the
+        # ref-count lock (a same-thread re-acquire deadlocks). Enqueue the
+        # release on a lock-free deque the worker drains outside its lock.
         if not getattr(self, "_counted", False):
             return
         try:
@@ -81,7 +85,7 @@ class ObjectRef:
 
             cw = global_worker.core_worker
             if cw is not None and cw.connected:
-                cw.remove_local_ref(self._id.binary())
+                cw.defer_ref_release(self._id.binary())
         except Exception:
             pass
 

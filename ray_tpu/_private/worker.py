@@ -23,6 +23,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import object_store, serialization
@@ -127,6 +128,13 @@ class CoreWorker:
         self._put_index = 0
         self._local_refs: Dict[bytes, int] = {}
         self._owned: set = set()
+        # Lock-free queue of ref releases deferred from ObjectRef.__del__
+        # (GC can fire inside locked sections; see defer_ref_release).
+        self._deferred_releases: deque = deque()
+        threading.Thread(
+            target=self._release_drain_loop,
+            name=f"ref-release-{self.client_id[:6]}", daemon=True,
+        ).start()
         # --- borrower protocol (ray: reference_count.h:61) ----------------
         # Owned oids pinned by outstanding serialized copies (task args in
         # flight, containment handoffs). Count-based; released when the
@@ -996,6 +1004,25 @@ class CoreWorker:
         with self._lock:
             self._local_refs[ref.binary()] = self._local_refs.get(ref.binary(), 0) + 1
         ref._counted = True  # __del__ releases this count
+
+    def defer_ref_release(self, ref_binary: bytes):
+        """Called from ObjectRef.__del__ (any thread, any GC point):
+        deque.append is atomic and lock-free, so this is safe even when the
+        interpreter is mid-way through a locked core-worker section. The
+        release-drain thread applies the actual decrement."""
+        self._deferred_releases.append(ref_binary)
+
+    def _release_drain_loop(self):
+        while getattr(self, "connected", True):
+            try:
+                oid = self._deferred_releases.popleft()
+            except IndexError:
+                time.sleep(0.02)
+                continue
+            try:
+                self.remove_local_ref(oid)
+            except Exception:
+                logger.exception("deferred ref release failed")
 
     def remove_local_ref(self, ref_binary: bytes):
         with self._lock:

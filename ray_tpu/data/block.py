@@ -18,6 +18,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute  # noqa: F401 — pa.compute is a lazy submodule; a
+# worker that only imports pyarrow crashes on pa.compute.* without this
 
 Block = pa.Table
 # Batches cross the user boundary in one of these shapes.
