@@ -124,6 +124,10 @@ class NodeInfo:
     resources_available: Dict[str, float] = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # Autoscaler inputs (ray: monitor.proto ResourceLoad):
+    pending_demand: list = field(default_factory=list)
+    idle: bool = False
+    idle_since: float = 0.0
 
 
 # ---------------------------------------------------------------------------

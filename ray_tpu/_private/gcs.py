@@ -321,9 +321,38 @@ class GcsServer:
             return {"reregister": True}
         node.last_heartbeat = time.monotonic()
         node.resources_available = payload["resources_available"]
+        node.pending_demand = payload.get("pending_demand", [])
+        idle = payload.get("idle", False)
+        if idle and not node.idle:
+            node.idle_since = time.monotonic()
+        node.idle = idle
         if not node.alive:
             node.alive = True
         return {}
+
+    async def rpc_get_load_metrics(self, conn: Connection, _):
+        """Autoscaler input: per-node demand + idle durations (ray:
+        monitor.proto:100 GetAllResourceUsage)."""
+        now = time.monotonic()
+        nodes = []
+        demand = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            nodes.append({
+                "node_id": n.node_id,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "labels": n.labels,
+                "idle_s": (now - n.idle_since) if n.idle else 0.0,
+            })
+            demand.extend(n.pending_demand)
+        # Unschedulable actors are demand too (ray: GcsAutoscalerStateManager
+        # folds pending actor creations into the load report).
+        for rec in self.actors.values():
+            if rec.state in (PENDING_CREATION, RESTARTING) and rec.spec.resources:
+                demand.append(dict(rec.spec.resources))
+        return {"nodes": nodes, "pending_demand": demand}
 
     async def rpc_get_nodes(self, conn: Connection, _):
         return self._view()

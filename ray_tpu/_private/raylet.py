@@ -180,6 +180,9 @@ class Raylet:
         self.waiting: Dict[bytes, _QueuedTask] = {}  # waiting on deps
         self.ready: deque = deque()
         self.running: Dict[bytes, _QueuedTask] = {}
+        # Tasks no cluster node can currently fit (ray: infeasible queue);
+        # reported as autoscaler demand, retried as capacity appears.
+        self.infeasible: Dict[bytes, _QueuedTask] = {}
         self.dep_waiters: Dict[bytes, List[bytes]] = {}  # object -> task_ids
         self.pg_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
@@ -217,6 +220,9 @@ class Raylet:
         )
         self._tasks.append(
             asyncio.get_running_loop().create_task(self._task_event_flush_loop())
+        )
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(self._infeasible_retry_loop())
         )
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
@@ -381,6 +387,36 @@ class Raylet:
         if self.gcs:
             await self.gcs.close()
 
+    def _pending_demand(self) -> List[Dict[str, float]]:
+        """Resource demand of queued tasks (infeasible + ready +
+        dep-waiting), aggregated by shape with counts so a unique shape is
+        never truncated away (ray: ResourceLoad aggregates by scheduling
+        class before capping)."""
+        shapes: Dict[tuple, dict] = {}
+        for qt in (list(self.infeasible.values()) + list(self.ready)
+                   + list(self.waiting.values())):
+            res = qt.spec.resources
+            if not res:
+                continue
+            key = tuple(sorted(res.items()))
+            entry = shapes.get(key)
+            if entry is None:
+                shapes[key] = {"bundle": dict(res), "count": 1}
+            else:
+                entry["count"] += 1
+        return list(shapes.values())[:100]  # cap on DISTINCT shapes
+
+    def _is_idle(self) -> bool:
+        """Safe-to-terminate idle: nothing queued or running, no actors,
+        all resources returned, and no objects in the local store (a
+        primary copy here may be the only copy in the cluster)."""
+        return (
+            not self.running and not self.ready and not self.waiting
+            and not self.infeasible and not self.local_actors
+            and self.resources_available == self.resources_total
+            and not self.store.object_ids()
+        )
+
     async def _heartbeat_loop(self):
         while True:
             try:
@@ -389,6 +425,8 @@ class Raylet:
                     {
                         "node_id": self.node_id,
                         "resources_available": dict(self.resources_available),
+                        "pending_demand": self._pending_demand(),
+                        "idle": self._is_idle(),
                     },
                     timeout=cfg.gcs_rpc_timeout_s,
                 )
@@ -607,13 +645,13 @@ class Raylet:
             while self.ready:
                 qt = self.ready.popleft()
                 if not res_fits(qt.resources, self.resources_available):
-                    # If infeasible on this node entirely, retry cluster-wide
-                    # scheduling after a delay (another node may gain the
-                    # resource, e.g. a PG bundle commit); else wait locally.
+                    # Infeasible on this node entirely: park it in the
+                    # explicit infeasible queue — visible to the demand
+                    # report (autoscaler scale-up) and retried when the
+                    # cluster gains capacity (ray: ClusterTaskManager's
+                    # infeasible queue reported to GCS). Else wait locally.
                     if not res_fits(qt.resources, self.resources_total):
-                        asyncio.get_running_loop().create_task(
-                            self._reschedule_later(qt.spec)
-                        )
+                        self.infeasible[qt.spec.task_id] = qt
                     else:
                         again.append(qt)
                     continue
@@ -632,9 +670,30 @@ class Raylet:
                 await asyncio.sleep(0.01)
                 self._dispatch_event.set()
 
-    async def _reschedule_later(self, spec: TaskSpec):
-        await asyncio.sleep(0.5)
-        await self._schedule_or_queue(spec, depth=0)
+    async def _infeasible_retry_loop(self):
+        """Re-run cluster scheduling for parked infeasible tasks once some
+        node's total capacity could fit them (a new node joined, a PG
+        bundle committed). A reschedule failure re-parks the task — one
+        dying peer must not kill the loop or drop the task."""
+        while True:
+            await asyncio.sleep(0.5)
+            if not self.infeasible:
+                continue
+            for tid, qt in list(self.infeasible.items()):
+                if not any(
+                    n.alive and res_fits(qt.resources, n.resources_total)
+                    for n in self.cluster_view.values()
+                ):
+                    continue
+                del self.infeasible[tid]
+                try:
+                    await self._schedule_or_queue(qt.spec, depth=0)
+                except Exception:
+                    logger.exception(
+                        "rescheduling infeasible task %s failed; re-parking",
+                        tid.hex()[:16],
+                    )
+                    self.infeasible.setdefault(tid, qt)
 
     async def _run_on_worker(self, qt: _QueuedTask, w: _Worker):
         self._emit_task_event(qt.spec, "RUNNING", pid=w.proc.pid)
@@ -1161,6 +1220,8 @@ class Raylet:
     async def rpc_cancel_task(self, conn: Connection, p):
         tid = p["task_id"]
         qt = self.waiting.pop(tid, None)
+        if qt is None:
+            qt = self.infeasible.pop(tid, None)
         if qt is None:
             for i, q in enumerate(self.ready):
                 if q.spec.task_id == tid:

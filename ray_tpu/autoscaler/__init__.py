@@ -1,0 +1,25 @@
+"""Autoscaler: demand-driven node launch/teardown.
+
+ray parity: python/ray/autoscaler/_private/{autoscaler.py:166
+StandardAutoscaler, resource_demand_scheduler.py:101, load_metrics.py:63,
+monitor.py:126} with pluggable NodeProvider (node_provider.py:13). The
+TPU-native delta: node types are TPU pod slices (a whole slice is the
+scaling granularity — you can't add half a v5e-8), and the included
+FakeTpuPodProvider launches local raylet processes advertising slice
+resources so autoscaler end-to-end runs without cloud APIs (analog of
+fake_multi_node/node_provider.py:237).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    FakeTpuPodProvider,
+    MockProvider,
+    NodeProvider,
+)
+
+__all__ = [
+    "StandardAutoscaler",
+    "NodeProvider",
+    "MockProvider",
+    "FakeTpuPodProvider",
+]

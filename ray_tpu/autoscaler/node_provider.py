@@ -1,0 +1,111 @@
+"""Node providers: the cloud-side of the autoscaler.
+
+ray parity: python/ray/autoscaler/node_provider.py:13 NodeProvider
+(create_node/terminate_node/non_terminated_nodes) + the fake local
+provider (autoscaler/_private/fake_multi_node/node_provider.py:237) that
+backs autoscaler tests without a cloud.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract. ``node_type`` names an entry of the
+    cluster config's available_node_types."""
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """node_id -> node_type."""
+        raise NotImplementedError
+
+
+class MockProvider(NodeProvider):
+    """In-memory provider for unit tests (ray: autoscaler_test_utils
+    MockProvider)."""
+
+    def __init__(self):
+        self._nodes: Dict[str, str] = {}
+        self.create_calls: List[tuple] = []
+        self.terminate_calls: List[str] = []
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        self.create_calls.append((node_type, count))
+        out = []
+        for _ in range(count):
+            nid = f"mock-{uuid.uuid4().hex[:8]}"
+            self._nodes[nid] = node_type
+            out.append(nid)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        self.terminate_calls.append(node_id)
+        self._nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return dict(self._nodes)
+
+
+class FakeTpuPodProvider(NodeProvider):
+    """Launches real local raylet processes advertising TPU-slice
+    resources — autoscaler end-to-end without hardware or cloud APIs.
+
+    Each created node is a NodeProcesses worker joining the given GCS,
+    with the node type's resources (e.g. {"TPU": 8, "CPU": 8} for a
+    v5e-8 slice) and a tpu-slice label carrying the type name.
+    """
+
+    def __init__(self, gcs_host: str, gcs_port: int, session_dir: str,
+                 node_types: Dict[str, dict]):
+        self.gcs_host = gcs_host
+        self.gcs_port = gcs_port
+        self.session_dir = session_dir
+        self.node_types = node_types
+        self._nodes: Dict[str, tuple] = {}  # provider_id -> (type, NodeProcesses)
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        from ray_tpu._private.node import NodeProcesses
+
+        spec = self.node_types[node_type]
+        out = []
+        for _ in range(count):
+            node = NodeProcesses(
+                head=False,
+                gcs_host=self.gcs_host,
+                gcs_port=self.gcs_port,
+                session_dir=self.session_dir,
+                resources=dict(spec.get("resources", {})),
+                labels={"tpu-slice": node_type},
+            )
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:6]}"
+            with self._lock:
+                self._nodes[pid] = (node_type, node)
+            out.append(pid)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(node_id, None)
+        if entry is not None:
+            entry[1].shutdown()
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return {nid: t for nid, (t, _) in self._nodes.items()}
+
+    def raylet_node_id(self, provider_id: str) -> Optional[str]:
+        entry = self._nodes.get(provider_id)
+        return entry[1].node_id if entry else None
+
+    def shutdown(self):
+        for nid in list(self._nodes):
+            self.terminate_node(nid)

@@ -1,0 +1,110 @@
+"""Autoscaler: unit (MockProvider) + e2e (FakeTpuPodProvider launches
+real raylets for TPU-slice demand).
+
+ray parity: python/ray/tests/test_autoscaler.py (MockProvider-driven) and
+test_autoscaler_fake_multinode.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeTpuPodProvider, MockProvider, StandardAutoscaler
+
+NODE_TYPES = {
+    "tpu_v5e_8": {"resources": {"TPU": 8.0, "CPU": 8.0},
+                  "min_workers": 0, "max_workers": 2},
+    "cpu_worker": {"resources": {"CPU": 4.0},
+                   "min_workers": 0, "max_workers": 3},
+}
+
+
+def test_scale_up_for_demand_unit():
+    provider = MockProvider()
+    scaler = StandardAutoscaler(provider, NODE_TYPES)
+    # 2 TPU bundles that no live node absorbs -> one v5e-8 slice covers
+    # the first, second fits the same slice's remaining capacity.
+    out = scaler.update(load={
+        "nodes": [],
+        "pending_demand": [{"TPU": 4.0}, {"TPU": 4.0}, {"CPU": 2.0}],
+    })
+    assert out["launched"].get("tpu_v5e_8") == 1
+    # the CPU bundle fit the slice's CPUs; no cpu_worker needed
+    assert "cpu_worker" not in out["launched"]
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_max_workers_cap_and_min_workers_floor():
+    provider = MockProvider()
+    types = {
+        "tpu_v5e_8": {"resources": {"TPU": 8.0}, "min_workers": 1,
+                      "max_workers": 2},
+    }
+    scaler = StandardAutoscaler(provider, types)
+    out = scaler.update(load={"nodes": [], "pending_demand": []})
+    assert out["launched"] == {"tpu_v5e_8": 1}  # min_workers floor
+
+    # Demand for 5 full slices: capped at max_workers=2 total.
+    out = scaler.update(load={
+        "nodes": [],
+        "pending_demand": [{"TPU": 8.0} for _ in range(5)],
+    })
+    assert len(provider.non_terminated_nodes()) == 2
+
+
+def test_no_relaunch_for_pending_nodes():
+    provider = MockProvider()
+    scaler = StandardAutoscaler(provider, NODE_TYPES)
+    load = {"nodes": [], "pending_demand": [{"TPU": 8.0}]}
+    scaler.update(load=load)
+    # Same unmet demand again, but the launched node is still booting
+    # (absent from load["nodes"]): its capacity must count, no relaunch.
+    scaler.update(load=load)
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_autoscaler_e2e_fake_tpu_pod(ray_start_cluster):
+    """Infeasible TPU task -> autoscaler launches a fake v5e slice raylet
+    -> task runs there; idle slice is torn down after the timeout."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)  # head: no TPUs
+    ray_tpu.init(address=cluster.address)
+
+    provider = FakeTpuPodProvider(
+        "127.0.0.1", cluster.head.gcs_port, cluster.head.session_dir,
+        NODE_TYPES,
+    )
+    scaler = StandardAutoscaler(
+        provider, NODE_TYPES, gcs_address=cluster.address,
+        idle_timeout_s=3.0,
+    )
+    try:
+        @ray_tpu.remote(resources={"TPU": 8.0})
+        def on_tpu():
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+        ref = on_tpu.remote()
+        # Let the demand reach a heartbeat, then reconcile.
+        deadline = time.monotonic() + 60
+        launched = {}
+        while time.monotonic() < deadline and not launched:
+            time.sleep(1.0)
+            launched = scaler.update()["launched"]
+        assert launched.get("tpu_v5e_8") == 1
+        tpu_node = ray_tpu.get(ref, timeout=120)
+        head_node = ray_tpu.get_runtime_context().get_node_id()
+        assert tpu_node != head_node
+
+        # After the task finishes and the slice idles, it is terminated.
+        deadline = time.monotonic() + 90
+        terminated = []
+        while time.monotonic() < deadline and not terminated:
+            time.sleep(1.5)
+            terminated = scaler.update()["terminated"]
+        assert terminated, "idle TPU slice was not scaled down"
+        assert provider.non_terminated_nodes() == {}
+    finally:
+        provider.shutdown()
