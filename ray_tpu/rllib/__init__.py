@@ -7,6 +7,8 @@ from ray_tpu.rllib.algorithm import (
     IMPALAConfig,
     PPO,
     PPOConfig,
+    SAC,
+    SACConfig,
     Algorithm,
     AlgorithmConfig,
 )
@@ -17,6 +19,7 @@ from ray_tpu.rllib.learner import (
     ImpalaLearner,
     Learner,
     PPOLearner,
+    SACLearner,
     vtrace,
 )
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
@@ -41,6 +44,9 @@ __all__ = [
     "PrioritizedReplayBuffer",
     "RLModule",
     "ReplayBuffer",
+    "SAC",
+    "SACConfig",
+    "SACLearner",
     "SampleBatch",
     "compute_gae",
     "make_env",

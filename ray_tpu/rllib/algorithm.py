@@ -25,6 +25,7 @@ from ray_tpu.rllib.learner import (
     ImpalaLearner,
     Learner,
     PPOLearner,
+    SACLearner,
 )
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import RLModule
@@ -311,6 +312,34 @@ class DQN(Algorithm):
         return metrics
 
 
+class SAC(Algorithm):
+    """Discrete soft actor-critic — off-policy like DQN, but the learner
+    carries twin Q towers + auto temperature (ray parity:
+    rllib/algorithms/sac, discrete variant)."""
+
+    _learner_cls = SACLearner
+
+    def setup(self, config):
+        super().setup(config)
+        self.buffer = ReplayBuffer(self._algo_config.replay_buffer_capacity,
+                                   seed=self._algo_config.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        for frag in self._sample_all():
+            self._timesteps += frag.count
+            self.buffer.add(frag)
+        if len(self.buffer) < cfg.num_steps_sampled_before_learning:
+            return {"buffer_size": len(self.buffer)}
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            batch = self.buffer.sample(cfg.minibatch_size)
+            metrics = self.learner.update(batch)
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(PPO)
@@ -327,3 +356,11 @@ class DQNConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(DQN)
         self.lr = 1e-3
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self.lr = 3e-4
+        self.tau = 0.01
+        self.target_entropy = None  # default: 0.6 * log(num_actions)

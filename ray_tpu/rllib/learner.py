@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, Optional, Tuple
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -235,3 +236,154 @@ class DQNLearner(Learner):
 
     def sync_target(self):
         self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
+
+class _TwinQ(nn.Module):
+    """Two independent per-action Q MLPs (discrete SAC's clipped double-Q;
+    reference analog: rllib/algorithms/sac — torch twin Q towers)."""
+
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        qs = []
+        for tower in ("q1", "q2"):
+            x = obs
+            for i, h in enumerate(self.hiddens):
+                x = nn.relu(nn.Dense(h, name=f"{tower}_fc_{i}")(x))
+            qs.append(nn.Dense(self.num_actions, name=f"{tower}_out")(x))
+        return qs[0], qs[1]
+
+
+class SACLearner(Learner):
+    """Discrete soft actor-critic (Christodoulou 2019): categorical policy
+    from the shared module's logits head, twin per-action Q towers with
+    polyak-averaged targets, and auto-tuned temperature toward a fraction
+    of max entropy. All three updates fuse into one jitted step."""
+
+    def __init__(self, module: RLModule, config):
+        super().__init__(module, config)
+        net = module.net
+        gamma = config.gamma
+        tau = getattr(config, "tau", 0.01)
+        target_entropy = getattr(config, "target_entropy", None)
+        if target_entropy is None:
+            target_entropy = 0.6 * float(jnp.log(module.num_actions))
+
+        self.qnet = _TwinQ(module.num_actions,
+                           tuple(config.model.get("hiddens", (64, 64))))
+        dummy = jnp.zeros((1, *module.obs_shape), jnp.float32)
+        self.q_params = self.qnet.init(
+            jax.random.PRNGKey(config.seed + 1), dummy
+        )["params"]
+        self.target_q_params = jax.tree.map(jnp.copy, self.q_params)
+        self.log_alpha = jnp.zeros(())
+        self.q_tx = optax.adam(config.lr)
+        self.q_opt_state = self.q_tx.init(self.q_params)
+        self.alpha_tx = optax.adam(config.lr)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+
+        def policy_dist(params, obs):
+            logits, _ = net.apply({"params": params}, obs)
+            logp = jax.nn.log_softmax(logits)
+            return jnp.exp(logp), logp
+
+        def q_loss_fn(q_params, pi_params, target_q, log_alpha, mb):
+            alpha = jnp.exp(log_alpha)
+            probs_n, logp_n = policy_dist(pi_params, mb[sb.NEXT_OBS])
+            tq1, tq2 = self.qnet.apply({"params": target_q}, mb[sb.NEXT_OBS])
+            soft_v = (probs_n * (jnp.minimum(tq1, tq2) - alpha * logp_n)).sum(-1)
+            target = mb[sb.REWARDS] + gamma * (
+                1.0 - mb[sb.DONES].astype(jnp.float32)
+            ) * soft_v
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = self.qnet.apply({"params": q_params}, mb[sb.OBS])
+            idx = mb[sb.ACTIONS][:, None].astype(jnp.int32)
+            q1a = jnp.take_along_axis(q1, idx, axis=1)[:, 0]
+            q2a = jnp.take_along_axis(q2, idx, axis=1)[:, 0]
+            return ((q1a - target) ** 2 + (q2a - target) ** 2).mean()
+
+        def pi_loss_fn(pi_params, q_params, log_alpha, mb):
+            alpha = jnp.exp(log_alpha)
+            probs, logp = policy_dist(pi_params, mb[sb.OBS])
+            q1, q2 = self.qnet.apply({"params": q_params}, mb[sb.OBS])
+            qmin = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+            loss = (probs * (alpha * logp - qmin)).sum(-1).mean()
+            entropy = -(probs * logp).sum(-1).mean()
+            return loss, entropy
+
+        def alpha_loss_fn(log_alpha, entropy):
+            # grows alpha while entropy < target, shrinks it above
+            return log_alpha * (entropy - target_entropy)
+
+        def train_step(pi_params, q_params, target_q, log_alpha,
+                       pi_opt, q_opt, alpha_opt, mb):
+            q_loss, q_grads = jax.value_and_grad(q_loss_fn)(
+                q_params, pi_params, target_q, log_alpha, mb
+            )
+            q_updates, q_opt = self.q_tx.update(q_grads, q_opt, q_params)
+            q_params = optax.apply_updates(q_params, q_updates)
+
+            (pi_loss, entropy), pi_grads = jax.value_and_grad(
+                pi_loss_fn, has_aux=True
+            )(pi_params, q_params, log_alpha, mb)
+            pi_updates, pi_opt = self.tx.update(pi_grads, pi_opt, pi_params)
+            pi_params = optax.apply_updates(pi_params, pi_updates)
+
+            a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(
+                log_alpha, jax.lax.stop_gradient(entropy)
+            )
+            a_updates, alpha_opt = self.alpha_tx.update(
+                a_grad, alpha_opt, log_alpha
+            )
+            log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+            target_q = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_q, q_params
+            )
+            metrics = {
+                "q_loss": q_loss,
+                "pi_loss": pi_loss,
+                "alpha_loss": a_loss,
+                "alpha": jnp.exp(log_alpha),
+                "entropy": entropy,
+            }
+            return (pi_params, q_params, target_q, log_alpha,
+                    pi_opt, q_opt, alpha_opt, metrics)
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.module.params, self.q_params, self.target_q_params,
+         self.log_alpha, self.opt_state, self.q_opt_state,
+         self.alpha_opt_state, metrics) = self._train_step(
+            self.module.params, self.q_params, self.target_q_params,
+            self.log_alpha, self.opt_state, self.q_opt_state,
+            self.alpha_opt_state, jmb,
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_optimizer_state(self):
+        return {
+            "pi": self.opt_state,
+            "q": self.q_opt_state,
+            "alpha": self.alpha_opt_state,
+            "q_params": self.q_params,
+            "target_q_params": self.target_q_params,
+            "log_alpha": self.log_alpha,
+        }
+
+    def set_optimizer_state(self, state):
+        if state is None:
+            self.opt_state = self.tx.init(self.module.params)
+            self.q_opt_state = self.q_tx.init(self.q_params)
+            self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+            return
+        self.opt_state = state["pi"]
+        self.q_opt_state = state["q"]
+        self.alpha_opt_state = state["alpha"]
+        self.q_params = state["q_params"]
+        self.target_q_params = state["target_q_params"]
+        self.log_alpha = state["log_alpha"]

@@ -143,15 +143,36 @@ def _import_application(import_path: str):
 
 
 def _apply_overrides(app, overrides: List[DeploymentSchema]):
-    """Re-parameterize deployments in a bound graph by name."""
+    """Re-parameterize deployments by name on a COPY of the bound graph —
+    the imported module's Application is a cached module-global that later
+    deploys of the same import_path must see unmodified."""
+    from ray_tpu.serve.deployment import Application, BoundDeployment
+
     by_name = {o.name: o for o in overrides}
-    for node in app._collect():
-        o = by_name.get(node.deployment.name)
-        if o is None:
-            continue
-        opts = {k: v for k, v in o.to_dict().items() if k != "name"}
-        node.deployment = node.deployment.options(**opts)
-    return app
+    copies: dict = {}
+
+    def copy_node(node):
+        if id(node) in copies:
+            return copies[id(node)]
+        def swap(v):
+            if isinstance(v, Application):
+                return Application(copy_node(v.root))
+            if isinstance(v, BoundDeployment):
+                return copy_node(v)
+            return v
+
+        args = tuple(swap(a) for a in node.init_args)
+        kwargs = {k: swap(v) for k, v in node.init_kwargs.items()}
+        dep = node.deployment
+        o = by_name.get(dep.name)
+        if o is not None:
+            opts = {k: v for k, v in o.to_dict().items() if k != "name"}
+            dep = dep.options(**opts)
+        new = BoundDeployment(dep, args, kwargs)
+        copies[id(node)] = new
+        return new
+
+    return Application(copy_node(app.root))
 
 
 def deploy_config(config: Dict[str, Any]) -> List[str]:

@@ -194,3 +194,32 @@ def test_tune_over_algorithm(ray_start_regular):
     ).fit()
     assert grid.num_errors == 0
     assert len(grid) == 2
+
+
+def test_sac_improves(ray_start_regular):
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("CartPole-native")
+        .env_runners(num_env_runners=1, rollout_fragment_length=200)
+        .training(minibatch_size=128,
+                  num_steps_sampled_before_learning=400,
+                  num_epochs=8)
+        .build()
+    )
+    first = None
+    best = -1.0
+    for _ in range(12):
+        result = algo.train()
+        r = result.get("episode_return_mean")
+        if r is not None:
+            if first is None:
+                first = r
+            best = max(best, r)
+        if "q_loss" in result:
+            assert np.isfinite(result["q_loss"])
+            assert np.isfinite(result["alpha"])
+    algo.stop()
+    assert first is not None
+    assert best > max(first, 25.0), (first, best)
