@@ -7,8 +7,10 @@ from ray_tpu.ops.attention import (
     online_block_update,
 )
 from ray_tpu.ops.ring_attention import ring_attention, ring_self_attention
+from ray_tpu.ops import moe
 
 __all__ = [
+    "moe",
     "attention_reference",
     "finalize_flash",
     "flash_attention",

@@ -1,0 +1,162 @@
+"""Mixture-of-experts with expert parallelism (EP).
+
+SURVEY §2.9: EP = "mesh axis + ragged_all_to_all style dispatch" — absent
+in the reference (Ray delegates to external stacks); TPU-native it is a
+first-class parallelism axis. This implements Switch-style top-1 routing
+(Fedus et al.) with GShard's dense dispatch/combine einsums, which map
+onto the MXU, and an expert-parallel execution mode where experts shard
+over a mesh axis and tokens travel by `lax.all_to_all` over ICI.
+
+Two execution modes with identical math:
+- ``moe_ffn``: all experts local (single chip / replicated).
+- ``moe_ffn_ep``: inside ``shard_map`` with experts sharded over
+  ``axis`` — dispatch (E, C, d) splits over the expert dim, an
+  all_to_all sends each expert its tokens from every data shard, local
+  experts run, and the inverse all_to_all returns outputs for combine.
+
+Capacity is static (compile-friendly): C = ceil(capacity_factor * T / E);
+overflow tokens are dropped by the dispatch mask (their combine weight is
+zero, so the residual path carries them — standard Switch behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def switch_gating(logits: jnp.ndarray, capacity: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 routing.
+
+    Args: logits (T, E); capacity C per expert.
+    Returns (dispatch, combine, aux_loss):
+      dispatch (T, E, C) one-hot token->slot assignment (bool as float),
+      combine (T, E, C) = dispatch * router prob,
+      aux_loss: Switch load-balance loss (scalar).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)   # (T, E)
+    # Queue positions in int32: a low-precision (bf16) cumsum silently
+    # collides slots past 256 tokens per expert (8-bit mantissa).
+    onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - onehot_i  # (T, E)
+    keep = (pos < capacity).astype(logits.dtype) * onehot    # (T, E)
+    slot = jax.nn.one_hot(
+        jnp.sum(pos, axis=-1), capacity, dtype=logits.dtype
+    )                                                        # (T, C)
+    dispatch = keep[:, :, None] * slot[:, None, :]           # (T, E, C)
+    gate = jnp.sum(probs * onehot, axis=-1)                  # (T,)
+    combine = dispatch * gate[:, None, None]
+    # load-balance loss: E * sum_e f_e * P_e (Switch eq. 4)
+    f = jnp.mean(onehot, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, num_experts: int,
+                    dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    """Router + stacked expert FFN parameters (experts stacked on dim 0 so
+    an EP shard slices contiguously)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": (jax.random.normal(k1, (d_model, num_experts)) * scale_in
+                   ).astype(dtype),
+        "wi": (jax.random.normal(k2, (num_experts, d_model, d_hidden))
+               * scale_in).astype(dtype),
+        "wo": (jax.random.normal(k3, (num_experts, d_hidden, d_model))
+               * scale_out).astype(dtype),
+    }
+
+
+def _expert_ffn(wi, wo, x):
+    """Per-expert FFN over (E, C, d) inputs; einsums ride the MXU."""
+    h = jnp.einsum("ecd,edh->ech", x, wi)
+    h = jax.nn.gelu(h)
+    return jnp.einsum("ech,ehd->ecd", h, wo)
+
+
+def moe_ffn(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
+            capacity_factor: float = 1.25
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense-dispatch MoE with all experts local.
+
+    x: (T, d). Returns (out (T, d), aux_loss)."""
+    E = params["router"].shape[1]
+    T = x.shape[0]
+    capacity = max(1, -(-int(capacity_factor * T) // E))  # ceil, as documented
+    logits = x @ params["router"]
+    dispatch, combine, aux = switch_gating(logits, capacity)
+    expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
+    expert_out = _expert_ffn(params["wi"], params["wo"], expert_in)
+    out = jnp.einsum("ecd,tec->td", expert_out, combine)
+    return out, aux
+
+
+def ep_loss_and_grads(loss_fn, params: Dict[str, jnp.ndarray],
+                      data_axis: str, ep_axis: str):
+    """The verified EP training-step pattern (call inside ``shard_map``
+    with tokens sharded over BOTH mesh axes — no shard may duplicate
+    another's tokens, or collective transposes double-count):
+
+    - differentiate the LOCAL loss scaled by 1/N_shards,
+    - global loss = psum over both axes (the global token mean),
+    - router grads psum over both axes; expert grads (ep-sharded) psum
+      over the data axis only.
+
+    Gradient parity with the dense path is exact (tests/test_moe.py).
+    ``loss_fn(params) -> local scalar`` (unscaled)."""
+    n = jax.lax.psum(1, data_axis) * jax.lax.psum(1, ep_axis)
+    scaled, grads = jax.value_and_grad(
+        lambda p: loss_fn(p) / n
+    )(params)
+    loss = jax.lax.psum(jax.lax.psum(scaled, data_axis), ep_axis)
+    grads = dict(grads)
+    for k in grads:
+        grads[k] = jax.lax.psum(grads[k], data_axis)
+        if k == "router":  # replicated over ep too
+            grads[k] = jax.lax.psum(grads[k], ep_axis)
+    return loss, grads
+
+
+def moe_ffn_ep(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
+               axis: str, capacity_factor: float = 1.25
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE: call inside ``shard_map`` with ``params["wi"]/
+    ["wo"]`` sharded over ``axis`` on the expert dim and ``x`` sharded over
+    the data axis. Tokens travel to their experts and back via
+    ``lax.all_to_all`` on the ``axis`` ring (ICI on TPU pods).
+
+    Router weights are replicated; gating runs on local tokens. The global
+    expert count is n * E_local."""
+    n = jax.lax.psum(1, axis)
+    E_local = params["wi"].shape[0]
+    E = n * E_local
+    T = x.shape[0]
+    capacity = max(1, -(-int(capacity_factor * T) // E))  # ceil, as documented
+    logits = x @ params["router"]
+    dispatch, combine, aux = switch_gating(logits, capacity)
+    # local dispatch to ALL global experts: (E, C, d)
+    expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
+    # exchange: split the expert dim across shards, concat the sender dim —
+    # each shard ends with (E_local, n*C, d): its experts' tokens from
+    # every data shard.
+    expert_in = jax.lax.all_to_all(
+        expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+    )
+    expert_out = _expert_ffn(params["wi"], params["wo"], expert_in)
+    # inverse exchange: send each sender's slice back, restore (E, C, d)
+    expert_out = jax.lax.all_to_all(
+        expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+    )
+    out = jnp.einsum("ecd,tec->td", expert_out, combine)
+    # aux loss is computed on local tokens; average over the data shards
+    # happens in the caller's loss pmean.
+    return out, aux

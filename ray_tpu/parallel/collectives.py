@@ -20,6 +20,18 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
+def shard_map_norep(body, *, mesh, in_specs, out_specs):
+    """shard_map with the output-replication check disabled — the kwarg was
+    renamed check_rep -> check_vma across jax versions; every call site
+    shares this shim instead of hand-rolling the try/except."""
+    try:
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 def psum(x, axis: str):
     return jax.lax.psum(x, axis_name=axis)
 
