@@ -7,30 +7,26 @@ of per-chip GPT-2-124M throughput of torch-DDP on A100. An A100 at the
 commonly reported ~38-40% MFU for this model does ~0.9 GFLOP/token effective
 -> ~130k tokens/s/chip; the 90% bar is therefore ~117k tokens/s/chip.
 vs_baseline = measured / 117_000 (>=1.0 beats the target).
+
+The bench sweeps (batch_size, remat) configurations — the VERDICT r1 levers:
+8x1024 tokens/step with remat off left the MXU idle — measuring each with a
+short timed run (OOM-safe), then reports the best. Sweep details go to
+stderr; stdout stays the single JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
-def main():
+def _measure(config_cls, batch_size, seq_len, remat, steps, warmup):
     import jax
-    import jax.numpy as jnp
 
     from ray_tpu.models import gpt2
 
-    devices = jax.devices()
-    on_tpu = devices[0].platform != "cpu"
-    # Sized for one v5e chip (16GB HBM): bf16 compute, f32 params.
-    if on_tpu:
-        batch_size, seq_len, steps, warmup = 8, 1024, 10, 3
-        config = gpt2.GPT2Config.gpt2_124m()
-    else:  # CPU smoke fallback so the bench always emits a line
-        batch_size, seq_len, steps, warmup = 2, 128, 3, 1
-        config = gpt2.GPT2Config.small_test()
-
+    config = config_cls(remat=remat)
     model, params, tx, opt_state = gpt2.make_train_state(
         config, jax.random.PRNGKey(0)
     )
@@ -39,25 +35,90 @@ def main():
         jax.random.PRNGKey(1), batch_size, seq_len, config.vocab_size
     )
     batch = {k: jax.device_put(v) for k, v in batch.items()}
-
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch)
     float(loss)  # hard sync: device_get round-trip (block_until_ready is not
     # a reliable fence through relayed/experimental PJRT backends)
-
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, batch)
     float(loss)
     dt = time.perf_counter() - t0
+    # free donated buffers before the next config compiles
+    del params, opt_state, batch
+    return batch_size * seq_len * steps / dt
 
-    tokens_per_sec = batch_size * seq_len * steps / dt
+
+def _tpu_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe the accelerator in a subprocess: a dead TPU tunnel makes
+    jax.devices() block indefinitely inside the PJRT client, which no
+    in-process timeout can interrupt. A probe that times out means we fall
+    back to the CPU smoke bench instead of hanging the driver."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("[bench] TPU probe timed out; falling back to CPU",
+              file=sys.stderr)
+        return False
+    platform = (out.stdout or "").strip().splitlines()[-1:] or [""]
+    ok = out.returncode == 0 and platform[0] not in ("", "cpu")
+    if not ok:
+        print(f"[bench] TPU probe failed (rc={out.returncode}, "
+              f"platform={platform[0]!r}); falling back to CPU",
+              file=sys.stderr)
+    return ok
+
+
+def main():
+    import jax
+
+    if not _tpu_reachable():
+        jax.config.update("jax_platforms", "cpu")
+
+    from ray_tpu.models import gpt2
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        seq_len, steps, warmup = 1024, 10, 3
+        config_cls = gpt2.GPT2Config.gpt2_124m
+        # (batch, remat): r1 shipped (8, False) at 0.665x; remat + larger
+        # batch is the standard MFU lever on a 16GB v5e chip.
+        sweep = [(8, False), (16, False), (16, True), (32, True), (64, True)]
+    else:  # CPU smoke fallback so the bench always emits a line
+        seq_len, steps, warmup = 128, 3, 1
+        config_cls = gpt2.GPT2Config.small_test
+        sweep = [(2, False)]
+
+    best = 0.0
+    best_cfg = sweep[0]
+    for batch_size, remat in sweep:
+        try:
+            tps = _measure(config_cls, batch_size, seq_len, remat, steps,
+                           warmup)
+        except Exception as e:  # OOM or compile failure: skip this point
+            print(f"[bench] ({batch_size}, remat={remat}) failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        print(f"[bench] batch={batch_size} remat={remat}: {tps:,.0f} tok/s",
+              file=sys.stderr)
+        if tps > best:
+            best, best_cfg = tps, (batch_size, remat)
+
     baseline = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
     print(json.dumps({
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(best, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tokens_per_sec / baseline, 4),
+        "vs_baseline": round(best / baseline, 4),
+        "config": {"batch_size": best_cfg[0], "remat": best_cfg[1],
+                   "seq_len": seq_len},
     }))
 
 
