@@ -107,6 +107,9 @@ class TaskSpec:
     # (ray: object_recovery_manager.h lineage reconstruction budget).
     reconstructions: int = 0
     submit_time: float = field(default_factory=time.time)
+    # Propagated tracing context {trace_id, span_id} (ray:
+    # tracing_helper.py:105-226 injects span context into task calls).
+    tracing_ctx: Optional[dict] = None
 
     def scheduling_class(self) -> tuple:
         return (tuple(sorted(self.resources.items())), self.name)

@@ -98,6 +98,24 @@ class TaskExecutor:
         if is_actor_task and self.max_concurrency == 1:
             await self._await_turn(spec.caller_id, spec.seq_no)
         try:
+            ctx = getattr(spec, "tracing_ctx", None)
+            if ctx is not None:
+                # A propagated span context means the submitter traces:
+                # record this execution as a child span (ray:
+                # tracing_helper.py _inject_tracing_into_function).
+                # Stateless on purpose — concurrent tasks on this loop must
+                # not share thread-local span stacks, and the span must
+                # record even when _execute raises.
+                from ray_tpu.util import tracing
+
+                start = time.time()
+                try:
+                    return await self._execute(spec, is_actor_task)
+                finally:
+                    tracing.record_remote_span(
+                        f"task::{spec.name}", start, time.time(), ctx,
+                        attributes={"task_id": spec.task_id.hex()[:16]},
+                    )
             return await self._execute(spec, is_actor_task)
         finally:
             if is_actor_task and self.max_concurrency == 1:

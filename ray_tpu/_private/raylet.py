@@ -830,6 +830,16 @@ class Raylet:
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
         env["RAY_TPU_STORE_DIR"] = self.store_dir
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if runtime_env and (runtime_env.get("working_dir_uri")
+                            or runtime_env.get("py_module_uris")):
+            # URIs the worker materializes before serving tasks
+            # (ray: raylet -> runtime-env agent CreateRuntimeEnv).
+            import json as _json
+
+            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps({
+                "working_dir_uri": runtime_env.get("working_dir_uri"),
+                "py_module_uris": runtime_env.get("py_module_uris"),
+            })
         # Workers must not grab the TPU unless a task asks for it; JAX inits
         # lazily so this is safe, but keep workers on CPU by default for
         # control-plane work (the trainer backend overrides per worker group).

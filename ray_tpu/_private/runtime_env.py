@@ -1,0 +1,169 @@
+"""Runtime-env materialization: working_dir + py_modules.
+
+ray parity: python/ray/_private/runtime_env/{packaging.py, working_dir.py,
+py_modules.py} + the per-node agent (agent/runtime_env_agent.py:159) and
+URI cache (uri_cache.py). TPU-native there is no separate agent process:
+the DRIVER packages local directories into content-addressed zips stored
+in the GCS KV, rewriting the runtime_env to carry URIs; each WORKER
+materializes the URIs it needs into a node-local cache before serving
+tasks (workers are pooled per runtime-env hash, so one worker serves one
+env). pip/conda are not supported in this offline image and raise
+up front rather than failing at task time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Optional
+
+_KV_NS = b"runtime_env_packages"
+MAX_PACKAGE_BYTES = 200 * 1024 * 1024
+# driver-side: abspath -> uploaded digest (per-process; content changes
+# during one driver's lifetime are not re-detected, matching the
+# reference's per-job packaging)
+_UPLOAD_CACHE: dict = {}
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def package_directory(path: str) -> tuple:
+    """Zip a directory into (content_hash, zip_bytes). Deterministic:
+    sorted entries, zeroed timestamps — equal trees hash equal."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path}")
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS
+                         and not d.startswith("."))
+        for f in sorted(files):
+            if f.startswith("."):
+                continue
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, path), full))
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            total += os.path.getsize(full)
+            if total > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package exceeds "
+                    f"{MAX_PACKAGE_BYTES >> 20}MB: {path}"
+                )
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            with open(full, "rb") as fh:
+                zf.writestr(info, fh.read())
+    blob = buf.getvalue()
+    digest = hashlib.sha256(blob).hexdigest()[:24]
+    return digest, blob
+
+
+def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
+                        ) -> Optional[dict]:
+    """Driver-side: package local dirs, upload to the GCS KV, rewrite the
+    env to URI form (ray: upload_package_to_gcs). Idempotent on already-
+    prepared envs; validates unsupported plugins early."""
+    if not runtime_env:
+        return runtime_env
+    for unsupported in ("pip", "conda", "container"):
+        if runtime_env.get(unsupported):
+            raise ValueError(
+                f"runtime_env[{unsupported!r}] is not supported in this "
+                "offline build (no package installation at task time); "
+                "bake dependencies into the image"
+            )
+    env = dict(runtime_env)
+
+    def upload(path: str) -> str:
+        # One walk+zip+upload per path per driver process: repeated
+        # .remote() calls with the same working_dir must not re-hash the
+        # tree on every submission (ray packages per job, not per task).
+        abspath = os.path.abspath(os.path.expanduser(path))
+        cached = _UPLOAD_CACHE.get(abspath)
+        if cached is not None:
+            return cached
+        digest, blob = package_directory(path)
+        key = digest.encode()
+        exists = core_worker.io.run(core_worker.gcs.request(
+            "kv_exists", {"ns": _KV_NS, "key": key}
+        ))
+        if not exists:
+            core_worker.io.run(core_worker.gcs.request(
+                "kv_put", {"ns": _KV_NS, "key": key, "value": blob}
+            ))
+        _UPLOAD_CACHE[abspath] = digest
+        return digest
+
+    if env.get("working_dir") and not env.get("working_dir_uri"):
+        env["working_dir_uri"] = upload(env.pop("working_dir"))
+    if env.get("py_modules") and not env.get("py_module_uris"):
+        uris = []
+        for mod_path in env.pop("py_modules"):
+            uris.append((os.path.basename(os.path.normpath(mod_path)),
+                         upload(mod_path)))
+        env["py_module_uris"] = uris
+    return env
+
+
+def _cache_root() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR") or "/tmp"
+    return os.path.join(base, "runtime_env_cache")
+
+
+def _fetch_and_extract(gcs_request, uri: str) -> str:
+    """Materialize one package URI into the node-local cache (ray:
+    uri_cache.py — content-addressed, so concurrent extracts converge)."""
+    target = os.path.join(_cache_root(), uri)
+    if os.path.isdir(target):
+        return target
+    blob = gcs_request("kv_get", {"ns": _KV_NS, "key": uri.encode()})
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {uri} missing from GCS")
+    tmp = target + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:  # lost the race: someone else extracted it
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+def materialize(core_worker, runtime_env: Optional[dict]) -> None:
+    """Worker-side: download + extract this worker's env before it serves
+    tasks (ray: RuntimeEnvAgent.CreateRuntimeEnv). working_dir becomes the
+    process CWD and lands on sys.path; py_modules land on sys.path under
+    their original import names."""
+    if not runtime_env:
+        return
+
+    def gcs_request(method, payload):
+        return core_worker.io.run(core_worker.gcs.request(method, payload))
+
+    wd_uri = runtime_env.get("working_dir_uri")
+    if wd_uri:
+        path = _fetch_and_extract(gcs_request, wd_uri)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    for name, uri in runtime_env.get("py_module_uris") or ():
+        path = _fetch_and_extract(gcs_request, uri)
+        # extracted dir IS the module content; expose it under its name
+        parent = os.path.join(_cache_root(), f"mods_{uri}")
+        os.makedirs(parent, exist_ok=True)
+        link = os.path.join(parent, name)
+        if not os.path.exists(link):
+            try:
+                os.symlink(path, link)
+            except OSError:
+                pass
+        if parent not in sys.path:
+            sys.path.insert(0, parent)

@@ -54,6 +54,17 @@ class _deser_container:
         _DESER_CTX.container = self.prev
 
 
+def _tracing_ctx():
+    """Current span context for propagation into outgoing specs (no-op
+    None when tracing is off)."""
+    try:
+        from ray_tpu.util import tracing
+
+        return tracing.current_context() if tracing.is_enabled() else None
+    except Exception:
+        return None
+
+
 class GetTimeoutError(TimeoutError):
     pass
 
@@ -295,6 +306,7 @@ class CoreWorker:
             retry_exceptions=retry_exceptions,
             caller_id=self.client_id.encode(),
             runtime_env=runtime_env,
+            tracing_ctx=_tracing_ctx(),
         )
         refs = self._register_returns(spec)
         self.io.call_soon(
@@ -451,6 +463,7 @@ class CoreWorker:
             max_retries=max_task_retries,
             seq_no=seq,
             caller_id=self.client_id.encode(),
+            tracing_ctx=_tracing_ctx(),
         )
         refs = self._register_returns(spec)
         self.io.call_soon(

@@ -41,6 +41,17 @@ def main():
         gcs_port=int(gcs_port),
         is_driver=False,
     )
+    # Materialize this worker's runtime env (working_dir/py_modules URIs)
+    # BEFORE attaching the executor: the pool keys workers by env hash, so
+    # every task routed here expects the env to be in place.
+    renv = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if renv:
+        import json
+
+        from ray_tpu._private.runtime_env import materialize
+
+        materialize(cw, json.loads(renv))
+
     TaskExecutor(cw)
     global_worker.core_worker = cw
     global_worker.mode = "worker"

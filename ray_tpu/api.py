@@ -234,6 +234,17 @@ def get_actor(name: str, namespace: Optional[str] = None) -> "ActorHandle":
 # ---------------------------------------------------------------------------
 
 
+def _prepare_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Package working_dir/py_modules into GCS-stored URIs before the spec
+    ships (ray: runtime_env packaging at submission time)."""
+    if not runtime_env:
+        return runtime_env
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    global_worker.check_connected()
+    return prepare_runtime_env(global_worker.core_worker, runtime_env)
+
+
 def _build_resources(opts: dict, default_cpu: float) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
     if opts.get("num_cpus") is not None:
@@ -347,7 +358,7 @@ class RemoteFunction:
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             name=self.__name__,
             func_blob=self._func_blob,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_runtime_env(opts.get("runtime_env")),
         )
         if num_returns == 1:
             return refs[0]
@@ -480,7 +491,7 @@ class ActorClass:
             lifetime=opts.get("lifetime"),
             name=opts.get("name"),
             namespace=opts.get("namespace"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_runtime_env(opts.get("runtime_env")),
         )
         return ActorHandle(actor_id, methods=method_returns,
                            max_task_retries=opts.get("max_task_retries", 0))

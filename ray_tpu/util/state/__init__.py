@@ -71,6 +71,8 @@ def list_tasks(filters: Optional[Iterable[Tuple]] = None,
     events = _gcs_request("list_task_events", {"limit": 100_000})
     latest: dict = {}
     for ev in events:
+        if ev.get("state") == "SPAN":  # tracing spans share the event log
+            continue
         key = (ev["task_id"], ev.get("attempt", 0))
         cur = latest.get(key)
         if cur is None or ev["ts"] >= cur["ts"]:

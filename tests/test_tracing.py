@@ -1,0 +1,60 @@
+"""Tracing: spans, driver->worker propagation, timeline integration.
+
+ray parity: python/ray/tests/test_tracing.py (opt-in OTel tracing with
+span context injected into task calls).
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def _wait_for(fn, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.3)
+    raise TimeoutError("condition not met")
+
+
+def test_spans_nest_and_propagate(ray_start_regular):
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def traced_work(x):
+            return x + 1
+
+        with tracing.span("driver-root", phase="test") as root:
+            assert ray_tpu.get(traced_work.remote(1), timeout=60) == 2
+            with tracing.span("inner"):
+                pass
+            trace_id = root["trace_id"]
+        tracing.flush()
+
+        spans = _wait_for(
+            lambda: [s for s in tracing.get_spans(trace_id)
+                     if s["name"] == "task::traced_work"] or None
+        )
+        # the worker-side execution span parents into the driver's root
+        all_spans = tracing.get_spans(trace_id)
+        by_name = {s["name"]: s for s in all_spans}
+        assert "driver-root" in by_name and "inner" in by_name
+        root_span = by_name["driver-root"]
+        assert by_name["inner"]["parent_span_id"] == root_span["task_id"] \
+            or by_name["inner"]["parent_span_id"] is not None
+        task_span = spans[0]
+        assert task_span["trace_id"] == trace_id
+        assert task_span["parent_span_id"] is not None
+        assert task_span["duration"] >= 0
+    finally:
+        tracing.disable()
+
+
+def test_disabled_tracing_is_noop(ray_start_regular):
+    tracing.disable()
+    with tracing.span("nope") as rec:
+        assert rec is None
+    assert tracing.current_context() is None
