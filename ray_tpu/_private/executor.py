@@ -108,6 +108,13 @@ class TaskExecutor:
                 # record even when _execute raises.
                 from ray_tpu.util import tracing
 
+                # Pre-generate this execution span's id so nested .remote()
+                # calls from the task body chain to THIS hop (the user-code
+                # thread adopts {trace, exec_span_id} as its context).
+                exec_span_id = tracing.new_span_id()
+                spec.tracing_ctx = {
+                    "trace_id": ctx["trace_id"], "span_id": exec_span_id,
+                }
                 start = time.time()
                 try:
                     return await self._execute(spec, is_actor_task)
@@ -115,6 +122,7 @@ class TaskExecutor:
                     tracing.record_remote_span(
                         f"task::{spec.name}", start, time.time(), ctx,
                         attributes={"task_id": spec.task_id.hex()[:16]},
+                        span_id=exec_span_id,
                     )
             return await self._execute(spec, is_actor_task)
         finally:
@@ -157,6 +165,7 @@ class TaskExecutor:
             sv = serialization.serialize_error(e, spec.name)
             return self._error_result(sv, app_error=False)
         try:
+            ctx = getattr(spec, "tracing_ctx", None)
             if is_actor_task:
                 method = getattr(self.actor_instance, spec.method_name)
                 if inspect.iscoroutinefunction(method):
@@ -167,7 +176,10 @@ class TaskExecutor:
                     value = await asyncio.wrap_future(cfut)
                 else:
                     value = await loop.run_in_executor(
-                        self.pool, lambda: method(*args, **kwargs)
+                        self.pool,
+                        lambda: self._invoke_traced(
+                            lambda: method(*args, **kwargs), ctx
+                        ),
                     )
             else:
                 func = cloudpickle.loads(spec.func_blob)
@@ -179,7 +191,10 @@ class TaskExecutor:
                     value = await asyncio.wrap_future(cfut)
                 else:
                     value = await loop.run_in_executor(
-                        self.pool, lambda: func(*args, **kwargs)
+                        self.pool,
+                        lambda: self._invoke_traced(
+                            lambda: func(*args, **kwargs), ctx
+                        ),
                     )
         except Exception as e:
             sv = serialization.serialize_error(e, spec.name)
@@ -187,6 +202,22 @@ class TaskExecutor:
         finally:
             self.current_task_id = None
         return self._package_returns(spec, value, start)
+
+    @staticmethod
+    def _invoke_traced(fn, ctx):
+        """Run user code on a pool thread with the propagated span context
+        adopted thread-locally, so nested .remote() submissions stay in the
+        submitter's trace (multi-hop). Pool threads run one task function
+        at a time, so the thread-local cannot leak across tasks."""
+        if ctx is None:
+            return fn()
+        from ray_tpu.util import tracing
+
+        tracing.set_remote_context(ctx)
+        try:
+            return fn()
+        finally:
+            tracing.set_remote_context(None)
 
     async def _run_async_method(self, method, args, kwargs):
         if self._async_sem is None or self._async_sem._value > self.max_concurrency:

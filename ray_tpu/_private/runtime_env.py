@@ -22,9 +22,10 @@ from typing import Optional
 
 _KV_NS = b"runtime_env_packages"
 MAX_PACKAGE_BYTES = 200 * 1024 * 1024
-# driver-side: abspath -> uploaded digest (per-process; content changes
-# during one driver's lifetime are not re-detected, matching the
-# reference's per-job packaging)
+# driver-side: (driver client_id, abspath) -> uploaded digest. Keyed per
+# connection so a digest cached against one cluster is never trusted on a
+# fresh cluster whose KV lacks the package; content changes during one
+# driver's lifetime are not re-detected (the reference packages per job).
 _UPLOAD_CACHE: dict = {}
 
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
@@ -84,7 +85,8 @@ def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
         # .remote() calls with the same working_dir must not re-hash the
         # tree on every submission (ray packages per job, not per task).
         abspath = os.path.abspath(os.path.expanduser(path))
-        cached = _UPLOAD_CACHE.get(abspath)
+        cache_key = (core_worker.client_id, abspath)
+        cached = _UPLOAD_CACHE.get(cache_key)
         if cached is not None:
             return cached
         digest, blob = package_directory(path)
@@ -96,7 +98,7 @@ def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
             core_worker.io.run(core_worker.gcs.request(
                 "kv_put", {"ns": _KV_NS, "key": key, "value": blob}
             ))
-        _UPLOAD_CACHE[abspath] = digest
+        _UPLOAD_CACHE[cache_key] = digest
         return digest
 
     if env.get("working_dir") and not env.get("working_dir_uri"):

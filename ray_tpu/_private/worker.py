@@ -60,7 +60,11 @@ def _tracing_ctx():
     try:
         from ray_tpu.util import tracing
 
-        return tracing.current_context() if tracing.is_enabled() else None
+        if tracing.is_enabled():
+            return tracing.current_context() or tracing.propagation_context()
+        # Not locally enabled, but an adopted remote context still rides
+        # through (multi-hop task graphs keep their trace).
+        return tracing.propagation_context()
     except Exception:
         return None
 

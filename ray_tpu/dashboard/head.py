@@ -1,0 +1,158 @@
+"""Dashboard head: aiohttp JSON API over the state/metrics surfaces.
+
+ray parity: dashboard/head.py:81 DashboardHead with the per-domain module
+routes collapsed onto ray_tpu.util.state + util.metrics + the job
+submission KV. Runs inside the driver process on its own thread (no
+separate head process needed — the GCS connection is shared).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+_server = None
+
+
+def _json_response(payload, status: int = 200):
+    from aiohttp import web
+
+    return web.Response(
+        text=json.dumps(payload, default=str),
+        content_type="application/json",
+        status=status,
+    )
+
+
+def _build_app():
+    from aiohttp import web
+
+    from ray_tpu.util import state
+
+    routes = web.RouteTableDef()
+
+    @routes.get("/api/v0/healthz")
+    async def healthz(request):
+        return _json_response({"status": "ok"})
+
+    def _listing(fn):
+        async def handler(request):
+            limit = request.query.get("limit")
+            rows = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: fn(limit=int(limit) if limit else None)
+            )
+            return _json_response(rows)
+
+        return handler
+
+    routes.get("/api/v0/nodes")(_listing(state.list_nodes))
+    routes.get("/api/v0/actors")(_listing(state.list_actors))
+    routes.get("/api/v0/tasks")(_listing(state.list_tasks))
+    routes.get("/api/v0/objects")(_listing(state.list_objects))
+    routes.get("/api/v0/placement_groups")(
+        _listing(state.list_placement_groups)
+    )
+    routes.get("/api/v0/jobs")(_listing(state.list_jobs))
+
+    @routes.get("/api/v0/tasks/summarize")
+    async def summarize(request):
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, state.summarize_tasks
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/timeline")
+    async def timeline(request):
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.timeline(None)
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/metrics")
+    async def metrics(request):
+        from ray_tpu.util import metrics as m
+
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, m.list_metrics
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/cluster_resources")
+    async def cluster_resources(request):
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        total = await loop.run_in_executor(None, ray_tpu.cluster_resources)
+        avail = await loop.run_in_executor(None, ray_tpu.available_resources)
+        return _json_response({"total": total, "available": avail})
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+class _DashboardServer:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._loop = None
+        self._error: Optional[BaseException] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dashboard-head", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30) or self._error is not None:
+            raise RuntimeError(
+                f"dashboard failed to start on {host}:{port}: "
+                f"{self._error or 'timed out'}"
+            )
+
+    def _run(self):
+        from aiohttp import web
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def serve():
+            runner = web.AppRunner(_build_app())
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        try:
+            self._loop.run_until_complete(serve())
+        except BaseException as e:  # surface bind/setup errors to __init__
+            self._error = e
+            self._started.set()
+            return
+        self._loop.run_forever()
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the JSON API server; returns the bound port. Requires a
+    connected driver (ray_tpu.init first)."""
+    global _server
+    if _server is not None:
+        return _server.port
+    from ray_tpu._private.worker import global_worker
+
+    global_worker.check_connected()
+    server = _DashboardServer(host, port)  # raises on bind/setup failure
+    _server = server
+    return _server.port
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.stop()
+        _server = None

@@ -187,6 +187,51 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_list(args):
+    """ray parity: `ray list tasks|actors|nodes|objects|placement-groups|
+    jobs` (util/state CLI)."""
+    filters = []
+    for f in args.filter or ():
+        if "!=" in f:
+            key, value = f.split("!=", 1)
+            filters.append((key, "!=", value))
+        elif "=" in f:
+            key, value = f.split("=", 1)
+            filters.append((key, "=", value))
+        else:  # reject bad syntax BEFORE paying the cluster connect
+            sys.exit(f"bad filter {f!r}: use key=value or key!=value")
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    fns = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+        "workers": state.list_workers,
+    }
+    rows = fns[args.resource](filters=filters, limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_summary(args):
+    """ray parity: `ray summary tasks`."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    for name, entry in sorted(state.summarize_tasks().items()):
+        print(f"{name:30s} total={entry['total']:5d} "
+              f"finished={entry['FINISHED']:5d} failed={entry['FAILED']:4d} "
+              f"running={entry['RUNNING']:4d} pending={entry['PENDING']:4d}")
+    ray_tpu.shutdown()
+
+
 def cmd_serve_deploy(args):
     """ray parity: `serve deploy config.yaml` (REST path collapsed to a
     direct client call)."""
@@ -265,6 +310,21 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("-o", "--output")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("list", help="list cluster state resources")
+    p.add_argument("resource", choices=[
+        "tasks", "actors", "nodes", "objects", "placement-groups", "jobs",
+        "workers",
+    ])
+    p.add_argument("--filter", action="append",
+                   help="key=value or key!=value (repeatable)")
+    p.add_argument("--limit", type=int)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task summary by name")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("serve", help="declarative Serve deploy/status")
     ssub = p.add_subparsers(dest="serve_command", required=True)

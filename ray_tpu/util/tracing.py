@@ -66,8 +66,20 @@ def current_context() -> Optional[Dict[str, str]]:
 
 def set_remote_context(ctx: Optional[Dict[str, str]]):
     """Adopt a propagated context as the parent for spans opened in this
-    thread (called by the executor before running a traced task)."""
+    thread (the executor sets it on the user-code thread while a traced
+    task runs, so nested .remote() calls stay in the trace)."""
     _local.remote_ctx = ctx
+
+
+def propagation_context() -> Optional[Dict[str, str]]:
+    """Context to stamp on outgoing task specs: the innermost open span,
+    else an adopted remote context. Unlike span(), this works even when
+    this process never called enable() — the submitter upstream decided
+    the trace exists, and it must survive multi-hop task graphs."""
+    ctx = current_context()
+    if ctx is not None:
+        return ctx
+    return getattr(_local, "remote_ctx", None)
 
 
 @contextmanager
@@ -109,6 +121,8 @@ def span(name: str, **attributes):
 
 
 def _record(rec: Dict[str, Any]):
+    if _otel_tracer is None:  # env-var enablement path never ran enable()
+        _try_otel()
     buf = getattr(_local, "buffer", None)
     if buf is None:
         buf = _local.buffer = []
@@ -127,9 +141,14 @@ def _record(rec: Dict[str, Any]):
             pass
 
 
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
 def record_remote_span(name: str, start: float, end: float,
                        parent_ctx: Dict[str, str],
-                       attributes: Optional[Dict[str, str]] = None):
+                       attributes: Optional[Dict[str, str]] = None,
+                       span_id: Optional[str] = None):
     """Record one completed span with an EXPLICIT propagated parent and
     flush immediately. Used by the task executor: it holds no thread-local
     state, so concurrently interleaved tasks cannot corrupt each other's
@@ -137,7 +156,7 @@ def record_remote_span(name: str, start: float, end: float,
     (the SUBMITTER's tracing decision rides the spec)."""
     rec = {
         "trace_id": parent_ctx["trace_id"],
-        "span_id": uuid.uuid4().hex[:16],
+        "span_id": span_id or new_span_id(),
         "parent_span_id": parent_ctx["span_id"],
         "name": name,
         "start": start,

@@ -1,0 +1,86 @@
+"""Dashboard JSON API + state CLI (ray parity: dashboard HTTP routes,
+`ray list` CLI)."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def ping(x):
+    return x
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_json_api(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    ray_tpu.get([ping.remote(i) for i in range(3)], timeout=60)
+    port = start_dashboard()
+    try:
+        assert _get(port, "/api/v0/healthz")["status"] == "ok"
+        nodes = _get(port, "/api/v0/nodes")
+        assert nodes and nodes[0]["alive"]
+        res = _get(port, "/api/v0/cluster_resources")
+        assert res["total"].get("CPU", 0) > 0
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            tasks = _get(port, "/api/v0/tasks")
+            if any(t["name"] == "ping" and t["state"] == "FINISHED"
+                   for t in tasks):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("ping tasks never appeared in the API")
+        summary = _get(port, "/api/v0/tasks/summarize")
+        assert summary["ping"]["FINISHED"] >= 3
+        assert isinstance(_get(port, "/api/v0/timeline"), list)
+        assert isinstance(_get(port, "/api/v0/actors"), list)
+    finally:
+        stop_dashboard()
+
+
+def test_cli_list_and_summary(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.get([ping.remote(i) for i in range(2)], timeout=60)
+    time.sleep(3)  # task events flush
+    host, port = global_worker.core_worker.gcs_addr
+
+    from ray_tpu._private.node import package_env
+
+    env = package_env()
+    env["RAY_TPU_GCS_ADDR"] = f"{host}:{port}"
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "list", "nodes"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)[0]["alive"] is True
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "list", "tasks",
+         "--filter", "state=FINISHED"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert all(r["state"] == "FINISHED" for r in rows)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "summary"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ping" in out.stdout

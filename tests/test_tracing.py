@@ -43,12 +43,45 @@ def test_spans_nest_and_propagate(ray_start_regular):
         by_name = {s["name"]: s for s in all_spans}
         assert "driver-root" in by_name and "inner" in by_name
         root_span = by_name["driver-root"]
-        assert by_name["inner"]["parent_span_id"] == root_span["task_id"] \
-            or by_name["inner"]["parent_span_id"] is not None
+        # span records carry span_id as task_id; inner must parent to root
+        assert by_name["inner"]["parent_span_id"] == root_span["task_id"]
         task_span = spans[0]
         assert task_span["trace_id"] == trace_id
         assert task_span["parent_span_id"] is not None
         assert task_span["duration"] >= 0
+    finally:
+        tracing.disable()
+
+
+def test_multihop_propagation(ray_start_regular):
+    """A traced task's nested .remote() call stays in the same trace
+    (ray: span context injected hop by hop)."""
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def leaf():
+            return "leaf"
+
+        @ray_tpu.remote
+        def mid():
+            import ray_tpu as rt
+
+            return rt.get(leaf.remote(), timeout=60)
+
+        with tracing.span("hop-root") as root:
+            assert ray_tpu.get(mid.remote(), timeout=120) == "leaf"
+            trace_id = root["trace_id"]
+        tracing.flush()
+
+        spans = _wait_for(
+            lambda: (lambda ss: ss if {"task::mid", "task::leaf"} <=
+                     {s["name"] for s in ss} else None)(
+                tracing.get_spans(trace_id))
+        )
+        by_name = {s["name"]: s for s in spans}
+        # leaf's span parents into mid's span: same trace, chained hops
+        assert by_name["task::leaf"]["parent_span_id"] == \
+            by_name["task::mid"]["task_id"]
     finally:
         tracing.disable()
 
