@@ -24,6 +24,13 @@ from ray_tpu.rllib.learner import (
 )
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+)
+from ray_tpu.rllib.offline import BC, BCConfig, BCLearner, read_json, write_json
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
@@ -37,7 +44,14 @@ __all__ = [
     "IMPALA",
     "IMPALAConfig",
     "ImpalaLearner",
+    "BC",
+    "BCConfig",
+    "BCLearner",
     "Learner",
+    "MultiAgentCartPole",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
     "PPO",
     "PPOConfig",
     "PPOLearner",

@@ -41,6 +41,26 @@ class Learner:
     def get_weights(self):
         return self.module.get_state()
 
+    def sgd_epochs(self, batch: "SampleBatch", keys=None) -> Dict[str, float]:
+        """Shared minibatch-SGD driver: shuffle + minibatch + jitted
+        train_step for config.num_epochs (used by PPO and BC)."""
+        cfg = self.config
+        rng = getattr(self, "_rng", None)
+        if rng is None:
+            rng = self._rng = np.random.default_rng(getattr(cfg, "seed", 0))
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            shuffled = batch.shuffled(rng)
+            for mb in shuffled.minibatches(cfg.minibatch_size):
+                if mb.count < 2:
+                    continue
+                jmb = {k: jnp.asarray(v) for k, v in mb.items()
+                       if keys is None or k in keys}
+                self.module.params, self.opt_state, metrics = (
+                    self._train_step(self.module.params, self.opt_state, jmb)
+                )
+        return {k: float(v) for k, v in metrics.items()}
+
     def set_weights(self, params):
         # Weights-only update: Adam moments survive (checkpoint restore and
         # Tune pause/resume must not silently cold-start the optimizer).
@@ -103,18 +123,7 @@ class PPOLearner(Learner):
         self._rng = np.random.default_rng(0)
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
-        cfg = self.config
-        metrics = {}
-        for _ in range(cfg.num_epochs):
-            shuffled = batch.shuffled(self._rng)
-            for mb in shuffled.minibatches(cfg.minibatch_size):
-                if mb.count < 2:
-                    continue
-                jmb = {k: jnp.asarray(v) for k, v in mb.items()}
-                self.module.params, self.opt_state, metrics = (
-                    self._train_step(self.module.params, self.opt_state, jmb)
-                )
-        return {k: float(v) for k, v in metrics.items()}
+        return self.sgd_epochs(batch)
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, next_values, dones,

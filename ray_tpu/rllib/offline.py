@@ -1,0 +1,127 @@
+"""Offline RL: sample writing/reading + behavior cloning.
+
+ray parity: rllib/offline/ (JsonWriter/JsonReader feeding offline
+algorithms) and rllib/algorithms/bc — train a policy from recorded
+(obs, action) data with no environment interaction; the env is only
+probed for spaces and used for evaluation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def write_json(batches: List[SampleBatch], path: str) -> str:
+    """Record sample batches as JSON lines (ray parity: JsonWriter)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for batch in batches:
+            f.write(json.dumps({
+                k: np.asarray(v).tolist() for k, v in batch.items()
+            }) + "\n")
+    return path
+
+
+def read_json(path: str) -> SampleBatch:
+    """Load recorded batches back (ray parity: JsonReader)."""
+    batches = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            batches.append(SampleBatch({
+                k: np.asarray(v) for k, v in row.items()
+            }))
+    if not batches:
+        raise ValueError(f"no batches in {path}")
+    return SampleBatch.concat(batches)
+
+
+class BCLearner(Learner):
+    """Supervised action cross-entropy on logged transitions (ray parity:
+    rllib/algorithms/bc — the new-stack BC loss)."""
+
+    def __init__(self, module, config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        super().__init__(module, config)
+        net = module.net
+
+        def loss_fn(params, mb):
+            logits, _ = net.apply({"params": params}, mb[sb.OBS])
+            logp = jax.nn.log_softmax(logits)
+            act = mb[sb.ACTIONS].astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, act[:, None], axis=1)[:, 0]
+            return nll.mean()
+
+        def train_step(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"bc_loss": loss}
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self.sgd_epochs(batch, keys=(sb.OBS, sb.ACTIONS))
+
+
+class BC(Algorithm):
+    """Behavior cloning: no sampling plane — each train() runs supervised
+    epochs over the offline dataset; evaluate() rolls the env."""
+
+    _learner_cls = BCLearner
+
+    def setup(self, config):
+        # BC never samples: one evaluation runner is all it needs — clamp
+        # BEFORE the fleet spawns rather than killing extras after.
+        self._algo_config.num_env_runners = 1
+        super().setup(config)
+        input_ = self._algo_config.offline_input
+        if input_ is None:
+            raise ValueError("BCConfig.offline_data(input_=...) is required")
+        if isinstance(input_, str):
+            self._dataset = read_json(input_)
+        elif isinstance(input_, SampleBatch):
+            self._dataset = input_
+        else:  # ray_tpu.data Dataset of obs/actions columns
+            rows = input_.take_all()
+            self._dataset = SampleBatch({
+                sb.OBS: np.asarray([r["obs"] for r in rows], np.float32),
+                sb.ACTIONS: np.asarray([r["actions"] for r in rows], np.int32),
+            })
+
+    def training_step(self) -> Dict:
+        metrics = self.learner.update(self._dataset)
+        self._timesteps += self._dataset.count
+        # keep the evaluation runner's weights current (BC never goes
+        # through the sampling loop that normally syncs)
+        self._sync_weights()
+        return metrics
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(BC)
+        self.offline_input = None
+        self.num_env_runners = 1
+        self.num_epochs = 1
+        self.lr = 1e-3
+
+    def offline_data(self, *, input_=None, **_kw):
+        """ray parity: AlgorithmConfig.offline_data(input_=...)."""
+        if input_ is not None:
+            self.offline_input = input_
+        return self
