@@ -6,7 +6,8 @@ from ray_tpu.air.config import (
     ScalingConfig,
 )
 from ray_tpu.air.result import Result
-from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig, TorchConfig
+from ray_tpu.train.backend import (Backend, BackendConfig, JaxConfig,
+                                   TensorflowConfig, TorchConfig)
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 from ray_tpu.train.session import (get_checkpoint, get_context,
                                    get_dataset_shard, report)
@@ -15,6 +16,8 @@ from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
     TorchTrainer,
+    SklearnTrainer,
+    TensorflowTrainer,
 )
 from ray_tpu.train.worker_group import WorkerGroup
 
@@ -34,6 +37,9 @@ __all__ = [
     "ScalingConfig",
     "TorchConfig",
     "TorchTrainer",
+    "TensorflowConfig",
+    "TensorflowTrainer",
+    "SklearnTrainer",
     "TrainingFailedError",
     "WorkerGroup",
     "get_checkpoint",

@@ -216,3 +216,86 @@ def test_torch_trainer_gloo(ray_start_regular):
     assert result.error is None, result.error
     assert result.metrics["sum"] == [3.0, 3.0, 3.0, 3.0]
     assert result.metrics["world"] == 2
+
+
+def test_tensorflow_trainer_tf_config_and_fit(ray_start_regular):
+    """TF_CONFIG is wired per worker (cluster spec + task index); a
+    single-worker keras fit runs under MultiWorkerMirroredStrategy
+    (ray parity: tensorflow_trainer.py)."""
+    import json as _json
+
+    from ray_tpu import train
+    from ray_tpu.train import TensorflowTrainer
+
+    def probe_loop():
+        import os
+
+        from ray_tpu import train as train_mod
+
+        cfg = _json.loads(os.environ["TF_CONFIG"])
+        ctx = train_mod.get_context()
+        train_mod.report({
+            "task_index": cfg["task"]["index"],
+            "world": len(cfg["cluster"]["worker"]),
+            "rank": ctx.get_world_rank(),
+        })
+
+    trainer = TensorflowTrainer(
+        probe_loop, scaling_config=train.ScalingConfig(num_workers=2)
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+    assert result.metrics["task_index"] == result.metrics["rank"]
+
+    def keras_loop():
+        import numpy as np
+        import tensorflow as tf
+
+        from ray_tpu import train as train_mod
+
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+        with strategy.scope():
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(4, activation="relu",
+                                      input_shape=(2,)),
+                tf.keras.layers.Dense(1),
+            ])
+            model.compile(optimizer="sgd", loss="mse")
+        X = np.random.rand(64, 2).astype("float32")
+        y = (X.sum(axis=1, keepdims=True)).astype("float32")
+        hist = model.fit(X, y, epochs=2, verbose=0)
+        train_mod.report({"loss": float(hist.history["loss"][-1])})
+
+    trainer = TensorflowTrainer(
+        keras_loop, scaling_config=train.ScalingConfig(num_workers=1)
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] >= 0.0
+
+
+def test_sklearn_trainer(ray_start_regular):
+    import numpy as np
+    import pandas as pd
+
+    from ray_tpu import data as rdata
+    from ray_tpu.train import SklearnTrainer
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    df = pd.DataFrame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(max_iter=200),
+        datasets={"train": rdata.from_pandas(df)},
+        label_column="label",
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["train_score"] > 0.9
+    import cloudpickle
+
+    model = cloudpickle.loads(result.checkpoint.to_dict()["model"])
+    assert model.predict(np.array([[2.0, 2.0, 0.0]]))[0] == 1
