@@ -48,9 +48,20 @@ class FileLogStore:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = None
 
+    # first bytes of a native-store (src/log_store.cpp) file — this store
+    # must refuse it rather than compact it down to nothing
+    NATIVE_MAGIC = b"RTPULG02"
+
     def load(self) -> Dict[str, dict]:
         tables: Dict[str, dict] = {}
         if os.path.exists(self.path):
+            with open(self.path, "rb") as probe:
+                if probe.read(8) == self.NATIVE_MAGIC:
+                    raise RuntimeError(
+                        f"{self.path} was written by the native log store "
+                        "but the native library is unavailable; rebuild "
+                        "src/ (make -C src) or move the file aside"
+                    )
             with open(self.path, "rb") as f:
                 while True:
                     header = f.read(_LEN.size)
@@ -102,5 +113,87 @@ class FileLogStore:
                 self._f = None
 
 
+class NativeLogStore:
+    """C++ append-log store (src/log_store.cpp) behind the same interface:
+    native framing, torn-tail truncation, and compaction; keys/values stay
+    pickled by this layer (opaque bytes to C++). Reference analog: the
+    RedisStoreClient persistence role, collapsed to a local log."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        import ctypes
+
+        from ray_tpu._private import native_store
+
+        lib = native_store.load_library()
+        if lib is None or not getattr(lib, "_has_log_store", False):
+            raise OSError("native library lacks the log store")
+        self._lib = lib
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._h = ctypes.c_void_p(
+            lib.rtpu_log_open(path.encode(), 1 if fsync else 0)
+        )
+        if not self._h:
+            raise OSError(f"native log store failed to open {path}")
+
+    def load(self) -> Dict[str, dict]:
+        import ctypes
+
+        if not self._h:
+            raise OSError("native log store is closed")
+        tables: Dict[str, dict] = {}
+        lib = self._lib
+        lib.rtpu_log_iter_start(self._h)
+        t = ctypes.POINTER(ctypes.c_uint8)()
+        k = ctypes.POINTER(ctypes.c_uint8)()
+        v = ctypes.POINTER(ctypes.c_uint8)()
+        tl = ctypes.c_uint64()
+        kl = ctypes.c_uint64()
+        vl = ctypes.c_uint64()
+        while lib.rtpu_log_iter_next(
+            self._h, ctypes.byref(t), ctypes.byref(tl), ctypes.byref(k),
+            ctypes.byref(kl), ctypes.byref(v), ctypes.byref(vl),
+        ):
+            table = ctypes.string_at(t, tl.value).decode()
+            key = pickle.loads(ctypes.string_at(k, kl.value))
+            value = pickle.loads(ctypes.string_at(v, vl.value))
+            tables.setdefault(table, {})[key] = value
+        return tables
+
+    def put(self, table: str, key, value) -> None:
+        if not self._h:
+            raise OSError("native log store is closed")
+        tb = table.encode()
+        kb = pickle.dumps(key, protocol=5)
+        if value is None:
+            rc = self._lib.rtpu_log_put(self._h, tb, len(tb), kb, len(kb),
+                                        None, 0)
+        else:
+            vb = pickle.dumps(value, protocol=5)
+            rc = self._lib.rtpu_log_put(self._h, tb, len(tb), kb, len(kb),
+                                        vb, len(vb))
+        if rc != 0:
+            raise OSError(
+                f"native log store write failed (disk full?): {table!r}"
+            )
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtpu_log_close(self._h)
+            self._h = None
+
+
 def make_store(persist_path: Optional[str]):
-    return FileLogStore(persist_path) if persist_path else NullStore()
+    """Native C++ log store when the library loads, Python fallback
+    otherwise (both replay + compact; formats are store-private)."""
+    if not persist_path:
+        return NullStore()
+    try:
+        from ray_tpu._private import native_store
+
+        if native_store.available():
+            # Open refuses foreign formats (returns null -> OSError), so a
+            # log written by the Python store falls through to it intact.
+            return NativeLogStore(persist_path)
+    except Exception:
+        pass
+    return FileLogStore(persist_path)

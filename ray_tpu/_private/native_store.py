@@ -84,6 +84,33 @@ def _configure(lib):
     lib.rtpu_store_list.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64
     ]
+    # append-log KV store (GCS persistence; src/log_store.cpp). Optional:
+    # a prebuilt .so without these symbols still serves the object store.
+    u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    try:
+        lib.rtpu_log_open
+    except AttributeError:
+        lib._has_log_store = False
+        return lib
+    lib._has_log_store = True
+    lib.rtpu_log_open.restype = ctypes.c_void_p
+    lib.rtpu_log_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rtpu_log_put.restype = ctypes.c_int
+    lib.rtpu_log_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.rtpu_log_count.restype = ctypes.c_uint64
+    lib.rtpu_log_count.argtypes = [ctypes.c_void_p]
+    lib.rtpu_log_iter_start.restype = None
+    lib.rtpu_log_iter_start.argtypes = [ctypes.c_void_p]
+    lib.rtpu_log_iter_next.restype = ctypes.c_int
+    lib.rtpu_log_iter_next.argtypes = [
+        ctypes.c_void_p, u8pp, u64p, u8pp, u64p, u8pp, u64p,
+    ]
+    lib.rtpu_log_close.restype = None
+    lib.rtpu_log_close.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -97,21 +124,45 @@ def load_library() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build_attempted:
+
+        def _stale() -> bool:
+            """A .so older than any source is from a previous build and may
+            be missing newer symbols — rebuild rather than crash on
+            AttributeError during _configure."""
+            if not os.path.exists(_LIB_PATH):
+                return True
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            for name in os.listdir(_SRC_DIR):
+                if name.endswith((".cpp", ".h")) and os.path.getmtime(
+                    os.path.join(_SRC_DIR, name)
+                ) > lib_mtime:
+                    return True
+            return False
+
+        if _stale() and not _build_attempted:
             _build_attempted = True
             try:
-                subprocess.run(
-                    ["make", "-C", _SRC_DIR, "-s"],
-                    check=True, capture_output=True, timeout=120,
-                )
+                # Cross-process file lock: many workers starting at once
+                # must not run concurrent builds of the same output (the
+                # Makefile links to a temp name + atomic mv, so already-
+                # mapped processes are safe either way).
+                import fcntl
+
+                with open(os.path.join(_SRC_DIR, ".build.lock"), "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    if _stale():  # may have been built while we waited
+                        subprocess.run(
+                            ["make", "-C", _SRC_DIR, "-s", "-B"],
+                            check=True, capture_output=True, timeout=120,
+                        )
             except Exception as e:  # no toolchain / build failure
                 logger.debug("native store build failed: %s", e)
-                return None
         if not os.path.exists(_LIB_PATH):
             return None
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError = stale .so missing newer symbols
             logger.warning("could not load native store: %s", e)
             return None
         return _lib

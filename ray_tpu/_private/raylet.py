@@ -185,6 +185,9 @@ class Raylet:
         self.infeasible: Dict[bytes, _QueuedTask] = {}
         self.dep_waiters: Dict[bytes, List[bytes]] = {}  # object -> task_ids
         self.pg_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
+        # per-actor FIFO routing (ordered delivery; see rpc_submit_task)
+        self._actor_route_queues: Dict[bytes, deque] = {}
+        self._actor_routers: set = set()
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         self._pull_gate = _PullGate(
             cfg.max_concurrent_pulls,
@@ -561,10 +564,59 @@ class Raylet:
     async def rpc_submit_task(self, conn: Connection, p):
         spec: TaskSpec = p["spec"]
         if spec.actor_id is not None and not spec.actor_creation:
-            await self._route_actor_task(spec, p.get("actor_addr"))
+            # Per-actor FIFO routing: enqueue SYNCHRONOUSLY (before any
+            # await) so queue order equals frame-arrival order, and drain
+            # with one router task per actor. Routing each task in its own
+            # dispatch task reorders them — concurrent wait_actor_alive
+            # awaits wake in arbitrary order, and the executor's seq gate
+            # then anchors on the wrong first arrival.
+            q = self._actor_route_queues.setdefault(spec.actor_id, deque())
+            q.append((spec, p.get("actor_addr")))
+            if spec.actor_id not in self._actor_routers:
+                self._actor_routers.add(spec.actor_id)
+                asyncio.get_running_loop().create_task(
+                    self._actor_router(spec.actor_id)
+                )
             return {}
         await self._schedule_or_queue(spec, depth=p.get("depth", 0))
         return {}
+
+    async def _actor_router(self, actor_id: bytes):
+        """Drain one actor's routing queue sequentially (delivery order =
+        submission order; execution concurrency is the executor's business,
+        ray: CoreWorkerDirectActorTaskSubmitter's per-actor send queue)."""
+        q = self._actor_route_queues[actor_id]
+        try:
+            while q:
+                spec, actor_addr = q.popleft()
+                try:
+                    await self._route_actor_task(spec, actor_addr)
+                except Exception as e:  # noqa: BLE001
+                    # The submitter already got its {} reply: a swallowed
+                    # routing failure would hang its ray.get forever.
+                    logger.exception(
+                        "routing actor task %s failed",
+                        spec.task_id.hex()[:16],
+                    )
+                    try:
+                        await self._send_task_failure(
+                            spec, f"actor task routing failed: {e}",
+                            retriable=True,
+                        )
+                    except Exception:
+                        pass
+        finally:
+            self._actor_routers.discard(actor_id)
+            if q:  # a task slipped in during the finally window
+                if actor_id not in self._actor_routers:
+                    self._actor_routers.add(actor_id)
+                    asyncio.get_running_loop().create_task(
+                        self._actor_router(actor_id)
+                    )
+            else:
+                # drop the empty deque: actors churn, the dict must not
+                # grow one entry per actor ever contacted
+                self._actor_route_queues.pop(actor_id, None)
 
     async def rpc_spill_submit(self, conn: Connection, p):
         await self._schedule_or_queue(p["spec"], depth=p.get("depth", 0))
@@ -934,11 +986,23 @@ class Raylet:
             self.actor_addr_cache.pop(spec.actor_id, None)
             await self._send_task_failure(spec, "actor node unreachable", retriable=True)
             return
-        try:
-            await peer.request("submit_task", {"spec": spec, "actor_addr": addr})
-        except Exception:
-            self.actor_addr_cache.pop(spec.actor_id, None)
-            await self._send_task_failure(spec, "actor node unreachable", retriable=True)
+
+        # Forward WITHOUT awaiting the round trip: the per-actor router
+        # must not serialize throughput to one task per RTT. In-order
+        # sends are enough for ordering (the remote enqueues synchronously
+        # on dispatch); the tracked task handles a failed forward.
+        async def _forward():
+            try:
+                await peer.request(
+                    "submit_task", {"spec": spec, "actor_addr": addr}
+                )
+            except Exception:
+                self.actor_addr_cache.pop(spec.actor_id, None)
+                await self._send_task_failure(
+                    spec, "actor node unreachable", retriable=True
+                )
+
+        asyncio.get_running_loop().create_task(_forward())
 
     async def _run_actor_task(self, spec: TaskSpec, w: _Worker):
         try:
