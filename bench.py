@@ -21,12 +21,13 @@ import sys
 import time
 
 
-def _measure(config_cls, batch_size, seq_len, remat, steps, warmup):
+def _measure(config_cls, batch_size, seq_len, remat, steps, warmup,
+             attention="auto"):
     import jax
 
     from ray_tpu.models import gpt2
 
-    config = config_cls(remat=remat)
+    config = config_cls(remat=remat, attention=attention)
     model, params, tx, opt_state = gpt2.make_train_state(
         config, jax.random.PRNGKey(0)
     )
@@ -88,28 +89,33 @@ def main():
     if on_tpu:
         seq_len, steps, warmup = 1024, 10, 3
         config_cls = gpt2.GPT2Config.gpt2_124m
-        # (batch, remat): r1 shipped (8, False) at 0.665x; remat + larger
-        # batch is the standard MFU lever on a 16GB v5e chip.
-        sweep = [(8, False), (16, False), (16, True), (32, True), (64, True)]
+        # (batch, remat, attention): r1 shipped (8, False, auto) at 0.665x;
+        # remat + larger batch is the standard MFU lever on a 16GB v5e
+        # chip, and the in-repo Pallas flash kernel gets a trial against
+        # the backend's fused attention.
+        sweep = [
+            (8, False, "auto"), (16, False, "auto"), (16, True, "auto"),
+            (32, True, "auto"), (64, True, "auto"), (32, True, "flash"),
+        ]
     else:  # CPU smoke fallback so the bench always emits a line
         seq_len, steps, warmup = 128, 3, 1
         config_cls = gpt2.GPT2Config.small_test
-        sweep = [(2, False)]
+        sweep = [(2, False, "auto")]
 
     best = 0.0
     best_cfg = sweep[0]
-    for batch_size, remat in sweep:
+    for batch_size, remat, attention in sweep:
         try:
             tps = _measure(config_cls, batch_size, seq_len, remat, steps,
-                           warmup)
+                           warmup, attention=attention)
         except Exception as e:  # OOM or compile failure: skip this point
-            print(f"[bench] ({batch_size}, remat={remat}) failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            print(f"[bench] ({batch_size}, remat={remat}, {attention}) "
+                  f"failed: {type(e).__name__}: {e}", file=sys.stderr)
             continue
-        print(f"[bench] batch={batch_size} remat={remat}: {tps:,.0f} tok/s",
-              file=sys.stderr)
+        print(f"[bench] batch={batch_size} remat={remat} "
+              f"attn={attention}: {tps:,.0f} tok/s", file=sys.stderr)
         if tps > best:
-            best, best_cfg = tps, (batch_size, remat)
+            best, best_cfg = tps, (batch_size, remat, attention)
 
     baseline = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
     print(json.dumps({
@@ -118,7 +124,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(best / baseline, 4),
         "config": {"batch_size": best_cfg[0], "remat": best_cfg[1],
-                   "seq_len": seq_len},
+                   "attention": best_cfg[2], "seq_len": seq_len},
     }))
 
 
