@@ -61,7 +61,7 @@ def rewrite_resources_for_pg(
 
 @dataclass
 class SchedulingStrategy:
-    """DEFAULT | SPREAD | node affinity | placement group."""
+    """DEFAULT | SPREAD | node affinity | node label | placement group."""
 
     kind: str = "DEFAULT"
     node_id: Optional[str] = None  # NodeAffinity
@@ -69,6 +69,10 @@ class SchedulingStrategy:
     pg_id: Optional[str] = None  # PlacementGroup
     pg_bundle_index: Optional[int] = None
     pg_capture_child_tasks: bool = False
+    # NodeLabel (ray: node_label_scheduling_policy.h:25): {key: cond} where
+    # cond is a str (equals), "!v" (not equals), a list (in), None (exists).
+    labels_hard: Optional[Dict[str, Any]] = None
+    labels_soft: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -202,7 +206,59 @@ def _total(n: NodeInfo) -> Dict[str, float]:
     return n.resources_total
 
 
-def pick_node(
+def _label_match(labels: Dict[str, str], selector: Optional[Dict[str, Any]]) -> bool:
+    """Evaluate a label selector: str = equals, "!v" = not-equals, list =
+    in, None = exists (ray: node_label_scheduling_policy.h In/NotIn/Exists).
+
+    Label values are strings by construction; conditions are coerced to
+    str so e.g. hard={"slice": [1, 2]} matches a node labeled "1"."""
+    if not selector:
+        return True
+    for k, cond in selector.items():
+        v = labels.get(k)
+        if cond is None:
+            if v is None:
+                return False
+        elif isinstance(cond, (list, tuple, set)):
+            if v is None or v not in {str(c) for c in cond}:
+                return False
+        elif isinstance(cond, str) and cond.startswith("!"):
+            if v == cond[1:]:
+                return False
+        else:
+            if v != str(cond):
+                return False
+    return True
+
+
+def pick_node_labels(
+    nodes: List[NodeInfo],
+    demand: Dict[str, float],
+    hard: Optional[Dict[str, Any]],
+    soft: Optional[Dict[str, Any]],
+) -> Optional[str]:
+    """Node-label policy (ray: node_label_scheduling_policy.h:25): hard
+    selector filters; prefer soft-matching nodes with available capacity,
+    then any available, then any feasible-by-total; least-utilized wins."""
+    cands = [
+        n for n in nodes
+        if n.alive and _label_match(n.labels, hard)
+        and res_fits(demand, n.resources_total)
+    ]
+    if not cands:
+        return None
+    avail = [n for n in cands if res_fits(demand, n.resources_available)]
+    pref = [n for n in avail if _label_match(n.labels, soft)]
+    pool = pref or avail or cands
+    best, best_score = None, -2.0
+    for n in sorted(pool, key=lambda n: n.node_id):
+        sc = _score(n, demand)
+        if sc > best_score:
+            best, best_score = n.node_id, sc
+    return best
+
+
+def pick_node_py(
     nodes: List[NodeInfo],
     spec_resources: Dict[str, float],
     strategy: SchedulingStrategy,
@@ -210,6 +266,7 @@ def pick_node(
     rr_state: List[int],
     spread_threshold: float = 0.5,
 ) -> Optional[str]:
+    """Pure-Python policy dispatch — the oracle the native engine must match."""
     if strategy.kind == "NODE_AFFINITY":
         for n in nodes:
             if n.node_id == strategy.node_id and n.alive:
@@ -218,9 +275,36 @@ def pick_node(
         if strategy.soft:
             return pick_node_hybrid(nodes, spec_resources, local_node_id, spread_threshold)
         return None
+    if strategy.kind == "NODE_LABEL":
+        return pick_node_labels(
+            nodes, spec_resources, strategy.labels_hard, strategy.labels_soft
+        )
     if strategy.kind == "SPREAD":
         return pick_node_spread(nodes, spec_resources, rr_state)
     return pick_node_hybrid(nodes, spec_resources, local_node_id, spread_threshold)
+
+
+def pick_node(
+    nodes: List[NodeInfo],
+    spec_resources: Dict[str, float],
+    strategy: SchedulingStrategy,
+    local_node_id: Optional[str],
+    rr_state: List[int],
+    spread_threshold: float = 0.5,
+) -> Optional[str]:
+    from ray_tpu._private import native_sched
+
+    if native_sched.available() and native_sched.encodable(
+        nodes, spec_resources, strategy
+    ):
+        return native_sched.pick_node(
+            nodes, spec_resources, strategy, local_node_id, rr_state,
+            spread_threshold,
+        )
+    return pick_node_py(
+        nodes, spec_resources, strategy, local_node_id, rr_state,
+        spread_threshold,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +316,19 @@ def place_bundles(
     nodes: List[NodeInfo], bundles: List[Dict[str, float]], strategy: str
 ) -> Optional[List[str]]:
     """Return node_id per bundle, or None if infeasible."""
+    from ray_tpu._private import native_sched
+
+    if native_sched.available() and native_sched.encodable(
+        nodes, {}, bundles=bundles
+    ):
+        return native_sched.place_bundles(nodes, bundles, strategy)
+    return place_bundles_py(nodes, bundles, strategy)
+
+
+def place_bundles_py(
+    nodes: List[NodeInfo], bundles: List[Dict[str, float]], strategy: str
+) -> Optional[List[str]]:
+    """Pure-Python bundle placement — the oracle the native engine must match."""
     alive = [n for n in nodes if n.alive]
     avail = {n.node_id: dict(n.resources_available) for n in alive}
 

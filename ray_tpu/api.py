@@ -271,12 +271,18 @@ def _build_scheduling(opts: dict) -> SchedulingStrategy:
     # util.scheduling_strategies objects
     from ray_tpu.util.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
         PlacementGroupSchedulingStrategy,
     )
 
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         return SchedulingStrategy(
             kind="NODE_AFFINITY", node_id=strategy.node_id, soft=strategy.soft
+        )
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(
+            kind="NODE_LABEL", labels_hard=strategy.hard,
+            labels_soft=strategy.soft,
         )
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
         pg = strategy.placement_group

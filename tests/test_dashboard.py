@@ -34,15 +34,17 @@ def test_dashboard_json_api(ray_start_regular):
         res = _get(port, "/api/v0/cluster_resources")
         assert res["total"].get("CPU", 0) > 0
 
+        # task events flush to the GCS in batches: wait for ALL three pings
+        # to be reported finished, not just the first batch
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             tasks = _get(port, "/api/v0/tasks")
-            if any(t["name"] == "ping" and t["state"] == "FINISHED"
-                   for t in tasks):
+            if sum(t["name"] == "ping" and t["state"] == "FINISHED"
+                   for t in tasks) >= 3:
                 break
             time.sleep(0.5)
         else:
-            raise AssertionError("ping tasks never appeared in the API")
+            raise AssertionError("3 finished ping tasks never appeared")
         summary = _get(port, "/api/v0/tasks/summarize")
         assert summary["ping"]["FINISHED"] >= 3
         assert isinstance(_get(port, "/api/v0/timeline"), list)

@@ -1,0 +1,184 @@
+"""Differential tests: native (C++) scheduling policies vs the Python oracle.
+
+The native engine (src/scheduler.cpp via ray_tpu/_private/native_sched.py)
+must pick the same node as the pure-Python policies in
+ray_tpu/_private/common.py for every strategy on randomized clusters —
+mirroring how the reference unit-tests its policy classes
+(ray: src/ray/raylet/scheduling/policy/scheduling_policy_test.cc).
+"""
+
+import random
+
+import pytest
+
+from ray_tpu._private import native_sched
+from ray_tpu._private.common import (
+    NodeInfo,
+    SchedulingStrategy,
+    pick_node_py,
+    place_bundles_py,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_sched.available(), reason="native scheduler not built"
+)
+
+
+def _rand_cluster(rng, n_nodes):
+    nodes = []
+    for i in range(n_nodes):
+        total = {"CPU": rng.choice([1, 2, 4, 8, 16])}
+        if rng.random() < 0.5:
+            total["TPU"] = rng.choice([1, 4, 8])
+        if rng.random() < 0.3:
+            total["memory"] = rng.choice([2.5, 8.0, 16.0])
+        avail = {
+            k: round(v * rng.choice([0.0, 0.25, 0.5, 0.75, 1.0]), 4)
+            for k, v in total.items()
+        }
+        labels = {}
+        if rng.random() < 0.6:
+            labels["zone"] = rng.choice(["a", "b", "c"])
+        if rng.random() < 0.4:
+            labels["tpu-slice"] = rng.choice(["s0", "s1"])
+        nodes.append(
+            NodeInfo(
+                node_id=f"node{i:03d}", host="127.0.0.1", port=0,
+                store_dir="", resources_total=total,
+                resources_available=avail, labels=labels,
+                alive=rng.random() > 0.1,
+            )
+        )
+    return nodes
+
+
+def _rand_demand(rng):
+    d = {"CPU": rng.choice([0.5, 1, 2, 4])}
+    if rng.random() < 0.3:
+        d["TPU"] = rng.choice([1, 4])
+    return d
+
+
+def _strategies(rng, nodes):
+    yield SchedulingStrategy()
+    yield SchedulingStrategy(kind="SPREAD")
+    nid = rng.choice(nodes).node_id if nodes else "nodeX"
+    yield SchedulingStrategy(kind="NODE_AFFINITY", node_id=nid, soft=False)
+    yield SchedulingStrategy(kind="NODE_AFFINITY", node_id=nid, soft=True)
+    yield SchedulingStrategy(kind="NODE_AFFINITY", node_id="missing", soft=True)
+    yield SchedulingStrategy(kind="NODE_LABEL", labels_hard={"zone": "a"})
+    yield SchedulingStrategy(
+        kind="NODE_LABEL", labels_hard={"zone": ["a", "b"]},
+        labels_soft={"tpu-slice": "s0"},
+    )
+    yield SchedulingStrategy(kind="NODE_LABEL", labels_hard={"zone": "!c"})
+    yield SchedulingStrategy(kind="NODE_LABEL", labels_hard={"tpu-slice": None})
+
+
+def test_pick_node_matches_python_oracle():
+    rng = random.Random(7)
+    checked = picked = 0
+    for trial in range(200):
+        nodes = _rand_cluster(rng, rng.randint(1, 12))
+        demand = _rand_demand(rng)
+        local = rng.choice(nodes).node_id if rng.random() < 0.7 else None
+        for strat in _strategies(rng, nodes):
+            rr_py, rr_nat = [trial % 5], [trial % 5]
+            want = pick_node_py(nodes, demand, strat, local, rr_py)
+            got = native_sched.pick_node(nodes, demand, strat, local, rr_nat, 0.5)
+            assert got == want, (
+                f"trial {trial} strat={strat}: native={got} py={want}\n"
+                f"demand={demand} local={local}\n"
+                + "\n".join(
+                    f"  {n.node_id} alive={n.alive} t={n.resources_total} "
+                    f"a={n.resources_available} l={n.labels}" for n in nodes
+                )
+            )
+            assert rr_nat == rr_py
+            checked += 1
+            picked += got is not None
+    assert checked > 1000 and picked > 100  # the sweep actually exercised both
+
+
+def test_place_bundles_matches_python_oracle():
+    rng = random.Random(11)
+    checked = placed = 0
+    for trial in range(200):
+        nodes = _rand_cluster(rng, rng.randint(1, 8))
+        bundles = [
+            {"CPU": rng.choice([0.5, 1, 2])} for _ in range(rng.randint(1, 5))
+        ]
+        for strategy in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+            want = place_bundles_py(nodes, bundles, strategy)
+            got = native_sched.place_bundles(nodes, bundles, strategy)
+            assert got == want, (
+                f"trial {trial} {strategy}: native={got} py={want}"
+            )
+            checked += 1
+            placed += got is not None
+    assert checked == 800 and placed > 200
+
+
+def test_wire_format_edge_cases_fall_back_consistently():
+    """Values the wire format cannot carry (separator chars, empty-string
+    conditions, non-string conditions) must not diverge from the oracle:
+    common.pick_node guards with encodable() and falls back to Python."""
+    from ray_tpu._private.common import pick_node, place_bundles
+
+    def node(nid, labels):
+        return NodeInfo(node_id=nid, host="h", port=0, store_dir="",
+                        resources_total={"CPU": 4},
+                        resources_available={"CPU": 4}, labels=labels)
+
+    # label value with a separator char -> encodable() is False
+    nodes = [node("n1", {"pool": "a,b"}), node("n2", {"pool": "c"})]
+    strat = SchedulingStrategy(kind="NODE_LABEL", labels_hard={"pool": "a,b"})
+    assert not native_sched.encodable(nodes, {"CPU": 1}, strat)
+    assert pick_node(nodes, {"CPU": 1}, strat, None, [0]) == "n1"
+
+    # int conditions match string labels identically on both paths
+    nodes = [node("n1", {"slice": "1"}), node("n2", {"slice": "9"})]
+    strat = SchedulingStrategy(kind="NODE_LABEL", labels_hard={"slice": [1, 2]})
+    want = pick_node_py(nodes, {"CPU": 1}, strat, None, [0])
+    assert want == "n1"
+    assert native_sched.pick_node(nodes, {"CPU": 1}, strat, None, [0], 0.5) == want
+
+    # empty-string equality cannot ride the wire -> oracle handles it
+    nodes = [node("n1", {"zone": ""})]
+    strat = SchedulingStrategy(kind="NODE_LABEL", labels_hard={"zone": ""})
+    assert not native_sched.encodable(nodes, {"CPU": 1}, strat)
+    assert pick_node(nodes, {"CPU": 1}, strat, None, [0]) == "n1"
+
+    # empty bundle list: [] on both paths, not ['']
+    assert native_sched.place_bundles(nodes, [], "PACK") == []
+    assert place_bundles(nodes, [], "PACK") == place_bundles_py(nodes, [], "PACK")
+
+
+def test_build_scheduling_converts_node_label_strategy():
+    from ray_tpu.api import _build_scheduling
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    s = _build_scheduling({
+        "scheduling_strategy": NodeLabelSchedulingStrategy(
+            hard={"zone": "a"}, soft={"tpu-slice": "s0"}
+        )
+    })
+    assert s.kind == "NODE_LABEL"
+    assert s.labels_hard == {"zone": "a"}
+    assert s.labels_soft == {"tpu-slice": "s0"}
+
+
+def test_node_label_strategy_end_to_end():
+    """NODE_LABEL picks only matching nodes; infeasible without a match."""
+    nodes = [
+        NodeInfo(node_id="n1", host="h", port=0, store_dir="",
+                 resources_total={"CPU": 4}, resources_available={"CPU": 4},
+                 labels={"zone": "a"}),
+        NodeInfo(node_id="n2", host="h", port=0, store_dir="",
+                 resources_total={"CPU": 4}, resources_available={"CPU": 4},
+                 labels={"zone": "b"}),
+    ]
+    strat = SchedulingStrategy(kind="NODE_LABEL", labels_hard={"zone": "b"})
+    assert native_sched.pick_node(nodes, {"CPU": 1}, strat, None, [0], 0.5) == "n2"
+    strat = SchedulingStrategy(kind="NODE_LABEL", labels_hard={"zone": "z"})
+    assert native_sched.pick_node(nodes, {"CPU": 1}, strat, None, [0], 0.5) is None
