@@ -143,6 +143,8 @@ class GcsServer:
         self.object_dir: Dict[bytes, Set[str]] = {}
         self.object_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self.subscribers: Dict[str, Set[Connection]] = {}  # channel -> conns
+        self._pub_buf: Dict[Connection, list] = {}  # batched pubsub outbox
+        self._pub_flush: Optional[asyncio.Task] = None
         self._pg_lock = asyncio.Lock()
         self._next_job = 1
         self._started = asyncio.Event()
@@ -238,6 +240,15 @@ class GcsServer:
         self._store.put("job", job_id, self.jobs[job_id])
 
     async def stop(self):
+        # drain the pubsub outbox first: publishes acked in the final tick
+        # (e.g. node-dead from a teardown path) must still reach subscribers
+        if self._pub_flush is not None:
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._pub_flush), timeout=2.0
+                )
+            except Exception:
+                pass
         for t in self._tasks:
             t.cancel()
         await self.server.stop()
@@ -484,14 +495,42 @@ class GcsServer:
         return {}
 
     async def _publish(self, channel: str, message):
+        """Queue the message per subscriber and flush in batches.
+
+        The reference batches pubsub delivery (ray: src/ray/pubsub/ — the
+        long-poll reply carries every message queued since the last poll).
+        Same effect here on duplex connections: messages published in the
+        same loop tick coalesce into one "pubsub_batch" notify per
+        subscriber, so a burst of table updates (actor churn, PG commits)
+        costs one frame per peer instead of one per message.
+        """
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
                 continue
-            try:
-                await conn.notify("pubsub", {"channel": channel, "message": message})
-            except Exception:
-                pass
+            self._pub_buf.setdefault(conn, []).append((channel, message))
+        if self._pub_buf and self._pub_flush is None:
+            self._pub_flush = asyncio.get_running_loop().create_task(
+                self._flush_pubsub()
+            )
+
+    async def _flush_pubsub(self):
+        try:
+            # one loop turn lets same-tick publishes pile into the batch
+            await asyncio.sleep(0)
+            while self._pub_buf:
+                buf, self._pub_buf = self._pub_buf, {}
+                for conn, batch in buf.items():
+                    if conn.closed:
+                        continue
+                    try:
+                        await conn.notify("pubsub_batch", {"batch": batch})
+                    except Exception:
+                        pass
+        finally:
+            # reset even if cancelled mid-await so later publishes can
+            # schedule a fresh flush
+            self._pub_flush = None
 
     # ------------------------------------------------------------------
     # Object directory (centralized variant of the ownership directory)

@@ -73,6 +73,14 @@ class NodeProcesses:
         labels: Optional[Dict[str, str]] = None,
     ):
         self.head = head
+        if head and not os.environ.get("RAY_TPU_CLUSTER_TOKEN"):
+            # Cluster-scoped RPC auth: every process spawned from here (and
+            # every driver sharing this env) inherits the token; rpcio
+            # rejects unauthenticated connects (see rpcio.py preamble).
+            # Remote drivers must export RAY_TPU_CLUSTER_TOKEN themselves.
+            import secrets
+
+            os.environ["RAY_TPU_CLUSTER_TOKEN"] = secrets.token_hex(16)
         self.session_dir = session_dir or _make_session_dir()
         self.logs = os.path.join(self.session_dir, "logs")
         os.makedirs(self.logs, exist_ok=True)

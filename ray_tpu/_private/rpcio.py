@@ -14,8 +14,11 @@ reference's separation of gRPC control from plasma/object-manager data.
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import itertools
 import logging
+import os
 import pickle
 import threading
 from typing import Any, Callable, Dict, Optional
@@ -29,6 +32,31 @@ KIND_NOTIFY = 3
 
 _HDR = 4
 _MAX_MSG = 1 << 31
+
+# --- connection authentication -----------------------------------------
+# Frames are pickles, and unpickling executes code — so no frame may be
+# read from an unauthenticated peer. Every client opens with a fixed-size
+# raw preamble [5B magic][64B sha256(token) hex] before any pickle frame;
+# the server closes mismatching connections without ever unpickling their
+# bytes. The token is RAY_TPU_CLUSTER_TOKEN (the head node generates one
+# at startup and propagates it through package_env; remote drivers export
+# it). The preamble is sent unconditionally — with an empty token it
+# hashes "" — so a token-bearing client and a token-less server can never
+# misparse each other's streams; they fail the digest compare and close.
+# Plays the role of the reference's cluster auth token scoping.
+
+_AUTH_MAGIC = b"RTPU1"
+_AUTH_LEN = len(_AUTH_MAGIC) + 64
+_AUTH_TIMEOUT = 10.0
+
+
+def cluster_token() -> str:
+    return os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
+
+
+def _auth_preamble(token: str) -> bytes:
+    digest = hashlib.sha256(token.encode()).hexdigest().encode()
+    return _AUTH_MAGIC + digest
 
 
 class RpcError(Exception):
@@ -190,6 +218,17 @@ class RpcServer:
         return self.port
 
     async def _accept(self, reader, writer):
+        try:
+            preamble = await asyncio.wait_for(
+                reader.readexactly(_AUTH_LEN), _AUTH_TIMEOUT
+            )
+        except Exception:
+            writer.close()
+            return
+        if not hmac.compare_digest(preamble, _auth_preamble(cluster_token())):
+            logger.warning("rejecting unauthenticated peer on :%d", self.port)
+            writer.close()
+            return
         conn = Connection(reader, writer, self.handler, name=f"server:{self.port}")
         self.connections.add(conn)
 
@@ -221,6 +260,8 @@ async def connect(host: str, port: int, handler=None, name: str = "client",
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, port)
+            writer.write(_auth_preamble(cluster_token()))
+            await writer.drain()
             conn = Connection(reader, writer, handler, name=name)
             # Client-side conns get disconnect callbacks too (raylet/worker
             # GCS-reconnect loops key off this).

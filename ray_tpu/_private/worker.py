@@ -681,9 +681,17 @@ class CoreWorker:
         return {"unknown": True}
 
     async def rpc_pubsub(self, conn: Connection, p):
-        for cb in self._pubsub_handlers.get(p["channel"], ()):
+        self._dispatch_pubsub(p["channel"], p["message"])
+
+    async def rpc_pubsub_batch(self, conn: Connection, p):
+        # batched delivery (GCS coalesces same-tick publishes per peer)
+        for channel, message in p["batch"]:
+            self._dispatch_pubsub(channel, message)
+
+    def _dispatch_pubsub(self, channel, message):
+        for cb in self._pubsub_handlers.get(channel, ()):
             try:
-                cb(p["message"])
+                cb(message)
             except Exception:
                 logger.exception("pubsub callback failed")
 

@@ -122,52 +122,149 @@ def _flash_scan(q, k, v, *, causal: bool, sm_scale: float, block_k: int):
 
 
 # ----------------------------------------------------------------------
-# Pallas TPU kernel
+# Pallas TPU kernels
 # ----------------------------------------------------------------------
+#
+# Grid-streamed K/V: the kv-block axis is the innermost ("arbitrary") grid
+# dimension, so only one (block_k, d) K/V tile is resident in VMEM at a
+# time — sequence length is bounded by HBM, not VMEM (the r1 kernel loaded
+# the full K/V per q-block, capping seq length). The forward also emits the
+# per-row logsumexp so the backward is real Pallas kernels (dq and dk/dv)
+# instead of a scan-recompute VJP.
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                  causal: bool, block_q: int, block_k: int, q_len: int,
-                  k_len: int):
-    # refs: q (block_q, d); k/v (k_len, d); o (block_q, d)
-    qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale
-    d = q.shape[-1]
-    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    nk = k_len // block_k
-
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                q_len: int, k_len: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
     q_offset = qi * block_q + (k_len - q_len)
+    k_offset = ki * block_k
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    # a causal block is live unless every row is above the diagonal
+    live = (q_offset + block_q - 1 >= k_offset) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[...].astype(jnp.float32) * sm_scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (rows + q_offset) >= (cols + i * block_k)
-            s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
+            s = jnp.where(rows + q_offset >= cols + k_offset, s, NEG_INF)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         safe_m = jnp.where(m_new > NEG_INF / 2, m_new, 0.0)
-        p = jnp.exp(s - safe_m[:, None])
-        p = jnp.where((m_new > NEG_INF / 2)[:, None], p, 0.0)
-        alpha = jnp.where(m > NEG_INF / 2, jnp.exp(m - safe_m), 0.0)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
+        alpha = jnp.where(m_prev > NEG_INF / 2, jnp.exp(m_prev - safe_m), 0.0)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    if causal:
-        # only blocks at/below the diagonal contribute
-        last = lax.min(nk, (q_offset + block_q + block_k - 1) // block_k)
-        m, l, acc = lax.fori_loop(0, last, body, (m, l, acc))
-    else:
-        m, l, acc = lax.fori_loop(0, nk, body, (m, l, acc))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        m = m_scr[...]
+        # rows with no live columns get lse=+inf => p == 0 in the backward
+        lse = jnp.where(
+            l[:, 0] == 0.0, jnp.inf,
+            jnp.where(m[:, 0] > NEG_INF / 2, m[:, 0], 0.0) + jnp.log(l_safe[:, 0]),
+        )
+        lse_ref[...] = lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale: float, causal: bool, block_q: int,
+                   block_k: int, q_len: int, k_len: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_offset = qi * block_q + (k_len - q_len)
+    k_offset = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+
+    live = (q_offset + block_q - 1 >= k_offset) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, None]
+        delta = delta_ref[...][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + q_offset >= cols + k_offset, s, NEG_INF)
+        p = jnp.exp(s - lse)  # normalized probs; lse=+inf rows -> 0
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += sm_scale * jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale: float,
+                    causal: bool, block_q: int, block_k: int, q_len: int,
+                    k_len: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    q_offset = qi * block_q + (k_len - q_len)
+    k_offset = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    live = (q_offset + block_q - 1 >= k_offset) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, None]
+        delta = delta_ref[...][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + q_offset >= cols + k_offset, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] += sm_scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 try:  # pallas import is TPU/CPU-interpret capable; guard for safety
@@ -179,9 +276,18 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
+def _compiler_params(interpret: bool, n_arbitrary: int = 1):
+    if interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel")
+        + ("arbitrary",) * n_arbitrary
+    )
+
+
 def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
                   block_q: int, block_k: int, interpret: bool):
-    """q,k,v: (B, S, D) with batch*heads folded into B."""
+    """q,k,v: (B, S, D) with batch*heads folded into B. -> (out, lse)."""
     b, q_len, d = q.shape
     k_len = k.shape[1]
     block_q = min(block_q, q_len)
@@ -189,51 +295,130 @@ def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
     assert q_len % block_q == 0, (q_len, block_q)
     assert k_len % block_k == 0, (k_len, block_k)
 
-    grid = (b, q_len // block_q)
+    grid = (b, q_len // block_q, k_len // block_k)
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, q_len=q_len, k_len=k_len,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
-            pl.BlockSpec((None, k_len, d), lambda bi, qi: (bi, 0, 0)),
-            pl.BlockSpec((None, k_len, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, q_len, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, q_len, d), q.dtype),
+            jax.ShapeDtypeStruct((b, q_len), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v)
+
+
+def _flash_pallas_bwd_kernels(q, k, v, do, lse, delta, *, causal: bool,
+                              sm_scale: float, block_q: int, block_k: int,
+                              interpret: bool):
+    b, q_len, d = q.shape
+    k_len = k.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+
+    qspec = lambda f: pl.BlockSpec((None, block_q, d), f)
+    kspec = lambda f: pl.BlockSpec((None, block_k, d), f)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len, k_len=k_len,
+        ),
+        grid=(b, q_len // block_q, k_len // block_k),
+        in_specs=[
+            qspec(lambda bi, qi, ki: (bi, qi, 0)),
+            kspec(lambda bi, qi, ki: (bi, ki, 0)),
+            kspec(lambda bi, qi, ki: (bi, ki, 0)),
+            qspec(lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
+            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
+        ],
+        out_specs=qspec(lambda bi, qi, ki: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, q_len, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len, k_len=k_len,
+        ),
+        grid=(b, k_len // block_k, q_len // block_q),
+        in_specs=[
+            qspec(lambda bi, ki, qi: (bi, qi, 0)),
+            kspec(lambda bi, ki, qi: (bi, ki, 0)),
+            kspec(lambda bi, ki, qi: (bi, ki, 0)),
+            qspec(lambda bi, ki, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bi, ki, qi: (bi, qi)),
+            pl.BlockSpec((None, block_q), lambda bi, ki, qi: (bi, qi)),
+        ],
+        out_specs=[
+            kspec(lambda bi, ki, qi: (bi, ki, 0)),
+            kspec(lambda bi, ki, qi: (bi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k_len, d), k.dtype),
+            jax.ShapeDtypeStruct((b, k_len, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_pallas_diff(q, k, v, causal, sm_scale, block_q, block_k,
                        interpret):
-    """Pallas forward with a recompute backward: the VJP re-runs the scan
-    formulation (same math, O(seq) memory) under jax.vjp, so training with
-    the TPU kernel is exact without materializing the attention matrix."""
-    return _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret)
+    """Differentiable Pallas flash attention: both directions are Pallas
+    kernels (forward saves the logsumexp; backward recomputes P per block
+    from q,k,lse — O(seq) memory, no attention matrix ever materialized)."""
+    out, _ = _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+    return out
 
 
 def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_pallas_diff(q, k, v, causal, sm_scale, block_q, block_k,
-                             interpret)
-    return out, (q, k, v)
+    out, lse = _flash_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret,
                       res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _flash_scan(q, k, v, causal=causal,
-                                    sm_scale=sm_scale, block_k=block_k),
-        q, k, v,
+    q, k, v, out, lse = res
+    # delta_i = rowsum(dO_i * O_i); tiny elementwise reduce — XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_pallas_bwd_kernels(
+        q, k, v, g, lse, delta, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
+    return dq, dk, dv
 
 
 _flash_pallas_diff.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)

@@ -15,12 +15,14 @@ from ray_tpu.tune.search.sample import (
     uniform,
 )
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Repeater, Searcher
+from ray_tpu.tune.search.tpe import TPESearcher
 
 __all__ = [
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "Repeater",
     "Searcher",
+    "TPESearcher",
     "choice",
     "grid_search",
     "lograndint",

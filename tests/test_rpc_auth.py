@@ -1,0 +1,49 @@
+"""RPC auth: pickle frames are only read from authenticated peers
+(rpcio preamble; plays the reference's cluster-auth-token role)."""
+
+import pickle
+import socket
+
+import ray_tpu
+
+
+def test_unauthenticated_peer_rejected(ray_start_regular):
+    """A raw TCP client that skips the auth preamble must be disconnected
+    without its pickle frame ever being dispatched."""
+    import os
+
+    from ray_tpu._private.worker import global_worker
+
+    assert os.environ.get("RAY_TPU_CLUSTER_TOKEN"), (
+        "head start must have generated a cluster token"
+    )
+    host, port = global_worker.core_worker.gcs_addr
+
+    s = socket.create_connection((host, port), timeout=10)
+    s.settimeout(10)
+    try:
+        payload = pickle.dumps((1, 0, "kv_keys", {"prefix": ""}), protocol=5)
+        s.sendall(len(payload).to_bytes(4, "little") + payload)
+        # server must close without replying (the frame is not a preamble)
+        got = b""
+        try:
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                got += chunk
+        except socket.timeout:
+            raise AssertionError(
+                "server kept an unauthenticated connection open"
+            )
+        assert got == b"", f"server answered an unauthenticated peer: {got!r}"
+    finally:
+        s.close()
+
+
+def test_authenticated_cluster_still_works(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21), timeout=60) == 42
