@@ -17,20 +17,6 @@ def main():
     gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)
     raylet_port = int(os.environ["RAY_TPU_RAYLET_PORT"])
 
-    # Some site customizations (e.g. the axon TPU tunnel) import jax at
-    # interpreter start and force their platform programmatically, defeating
-    # the JAX_PLATFORMS env var that runtime_env.env_vars set for this
-    # worker. Re-assert the env var's choice before any user code runs.
-    import sys
-
-    if "jax" in sys.modules and os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
-
     from ray_tpu._private.executor import TaskExecutor
     from ray_tpu._private.worker import CoreWorker, global_worker
 
@@ -51,6 +37,20 @@ def main():
         from ray_tpu._private.runtime_env import materialize
 
         materialize(cw, json.loads(renv))
+
+    # The JAX_PLATFORMS env var alone does not stop plugin backends (e.g.
+    # the axon TPU tunnel) from initializing — a dead tunnel then hangs the
+    # first dispatch indefinitely. jax.config.update IS honored, so when the
+    # runtime_env pinned a platform for this worker, assert it through the
+    # config API before any user code touches jax. Runs AFTER runtime-env
+    # materialization so a jax shipped via py_modules is the one imported.
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
 
     TaskExecutor(cw)
     global_worker.core_worker = cw

@@ -9,21 +9,26 @@ from ray_tpu.rllib.algorithm import (
     PPOConfig,
     SAC,
     SACConfig,
+    TD3,
+    TD3Config,
+    DDPG,
+    DDPGConfig,
     Algorithm,
     AlgorithmConfig,
 )
-from ray_tpu.rllib.env import CartPole, make_env, register_env
-from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.env import CartPole, Reacher1D, make_env, register_env
+from ray_tpu.rllib.env_runner import ContinuousEnvRunner, EnvRunner
 from ray_tpu.rllib.learner import (
     DQNLearner,
     ImpalaLearner,
     Learner,
     PPOLearner,
     SACLearner,
+    TD3Learner,
     vtrace,
 )
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
-from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.rl_module import ContinuousRLModule, RLModule
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
     MultiAgentEnv,
@@ -34,6 +39,14 @@ from ray_tpu.rllib.offline import BC, BCConfig, BCLearner, read_json, write_json
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "ContinuousEnvRunner",
+    "ContinuousRLModule",
+    "DDPG",
+    "DDPGConfig",
+    "Reacher1D",
+    "TD3",
+    "TD3Config",
+    "TD3Learner",
     "Algorithm",
     "AlgorithmConfig",
     "CartPole",

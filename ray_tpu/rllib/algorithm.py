@@ -26,6 +26,7 @@ from ray_tpu.rllib.learner import (
     Learner,
     PPOLearner,
     SACLearner,
+    TD3Learner,
 )
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import RLModule
@@ -340,6 +341,83 @@ class SAC(Algorithm):
         return metrics
 
 
+class TD3(Algorithm):
+    """Twin-delayed DDPG for continuous action spaces (ray parity:
+    rllib/algorithms/td3; with DDPGConfig's knobs, rllib/algorithms/ddpg).
+    Off-policy: continuous runners fill a replay buffer; the learner does
+    clipped double-Q critic steps with delayed actor/target updates."""
+
+    _learner_cls = TD3Learner
+
+    def setup(self, _config):
+        from ray_tpu.rllib.env import env_action_info, env_obs_shape
+        from ray_tpu.rllib.env_runner import ContinuousEnvRunner
+        from ray_tpu.rllib.rl_module import ContinuousRLModule
+
+        cfg = self._algo_config
+        probe = make_env(cfg.env, cfg.env_config)
+        obs_shape = env_obs_shape(probe)
+        action_info = env_action_info(probe)
+        if action_info["kind"] != "continuous":
+            raise ValueError(
+                f"TD3/DDPG need a continuous action space; {cfg.env!r} is "
+                f"{action_info['kind']}"
+            )
+        if hasattr(probe, "close"):
+            probe.close()
+        hiddens = tuple(cfg.model.get("hiddens", (64, 64)))
+        self.module = ContinuousRLModule(
+            obs_shape, action_info, hiddens=hiddens, seed=cfg.seed
+        )
+        self.learner = self._learner_cls(self.module, cfg)
+        runner_cls = ray_tpu.remote(
+            num_cpus=0.5,
+            runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
+        )(ContinuousEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                cfg.env, cfg.env_config, {"hiddens": hiddens},
+                seed=cfg.seed + i,
+                noise_scale=getattr(cfg, "exploration_noise", 0.1),
+                warmup_steps=getattr(cfg, "warmup_steps", 500),
+            )
+            for i in range(cfg.num_env_runners)
+        ]
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._timesteps = 0
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        for frag in self._sample_all():
+            self._timesteps += frag.count
+            self.buffer.add(frag)
+        if len(self.buffer) < cfg.num_steps_sampled_before_learning:
+            return {"buffer_size": len(self.buffer)}
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            metrics = self.learner.update(
+                self.buffer.sample(cfg.minibatch_size)
+            )
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+    def compute_single_action(self, obs, explore: bool = False):
+        obs = np.asarray(obs, np.float32)[None, :]
+        if explore:
+            import jax
+
+            return self.module.action_exploration(
+                obs, jax.random.PRNGKey(int(time.time() * 1e6) % 2**31)
+            )[0]
+        return self.module.action_greedy(obs)[0]
+
+
+class DDPG(TD3):
+    """DDPG = TD3 minus twin critics, target smoothing, and policy delay
+    (the DDPGConfig defaults flip those knobs)."""
+
+
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(PPO)
@@ -364,3 +442,29 @@ class SACConfig(AlgorithmConfig):
         self.lr = 3e-4
         self.tau = 0.01
         self.target_entropy = None  # default: 0.6 * log(num_actions)
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(TD3)
+        self.env = "Reacher1D-native"
+        self.lr = 1e-3
+        self.tau = 0.005
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1
+        self.warmup_steps = 500
+        self.num_steps_sampled_before_learning = 500
+        self.num_epochs = 20
+        self.minibatch_size = 128
+
+
+class DDPGConfig(TD3Config):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DDPG
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0

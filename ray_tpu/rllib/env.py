@@ -86,6 +86,52 @@ class CartPole:
 register_env("CartPole-native", lambda cfg: CartPole(cfg))
 
 
+class Reacher1D:
+    """Minimal continuous-control env (gymnasium API, numpy only): drive a
+    1-D point to a random target with bounded velocity commands. Dense
+    quadratic reward; a correct TD3/DDPG solves it in a few thousand steps —
+    the continuous learning-regression workhorse, as CartPole is for the
+    discrete stack."""
+
+    def __init__(self, env_config: Optional[dict] = None):
+        cfg = env_config or {}
+        self.max_steps = cfg.get("max_episode_steps", 60)
+        self.rng = np.random.default_rng(cfg.get("seed"))
+        self.observation_shape = (2,)
+        self.action_dim = 1
+        self.action_low = np.array([-1.0], np.float32)
+        self.action_high = np.array([1.0], np.float32)
+        self._pos = 0.0
+        self._target = 0.0
+        self._t = 0
+
+    def _obs(self):
+        return np.array([self._pos, self._target], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self._pos = float(self.rng.uniform(-1.0, 1.0))
+        self._target = float(self.rng.uniform(-1.0, 1.0))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1.0, 1.0))
+        self._pos = float(np.clip(self._pos + 0.2 * a, -2.0, 2.0))
+        self._t += 1
+        err = self._pos - self._target
+        reward = -(err * err)
+        truncated = self._t >= self.max_steps
+        return self._obs(), reward, False, truncated, {}
+
+    def close(self):
+        pass
+
+
+register_env("Reacher1D-native", lambda cfg: Reacher1D(cfg))
+
+
 def env_spaces(env) -> Tuple[tuple, int]:
     """(observation_shape, num_discrete_actions) for built-in or gym envs."""
     if hasattr(env, "observation_shape"):
@@ -95,3 +141,38 @@ def env_spaces(env) -> Tuple[tuple, int]:
     shape = tuple(obs_space.shape)
     n = int(act_space.n)
     return shape, n
+
+
+def env_action_info(env) -> dict:
+    """Action-space descriptor covering both families:
+    {"kind": "discrete", "n": int} or
+    {"kind": "continuous", "dim": int, "low": array, "high": array}."""
+    if hasattr(env, "num_actions"):
+        return {"kind": "discrete", "n": int(env.num_actions)}
+    if hasattr(env, "action_dim"):
+        return {
+            "kind": "continuous", "dim": int(env.action_dim),
+            "low": np.asarray(env.action_low, np.float32),
+            "high": np.asarray(env.action_high, np.float32),
+        }
+    act_space = env.action_space
+    if hasattr(act_space, "n"):
+        return {"kind": "discrete", "n": int(act_space.n)}
+    low = np.asarray(act_space.low, np.float32).reshape(-1)
+    high = np.asarray(act_space.high, np.float32).reshape(-1)
+    if not (np.isfinite(low).all() and np.isfinite(high).all()):
+        raise ValueError(
+            f"continuous action space has non-finite bounds "
+            f"(low={low}, high={high}); TD3/DDPG rescale tanh output into "
+            f"[low, high] — wrap the env to bound its actions"
+        )
+    return {
+        "kind": "continuous", "dim": int(np.prod(act_space.shape)),
+        "low": low, "high": high,
+    }
+
+
+def env_obs_shape(env) -> tuple:
+    if hasattr(env, "observation_shape"):
+        return tuple(env.observation_shape)
+    return tuple(env.observation_space.shape)

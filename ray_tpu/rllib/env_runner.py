@@ -13,8 +13,13 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
-from ray_tpu.rllib.env import env_spaces, make_env
-from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.env import (
+    env_action_info,
+    env_obs_shape,
+    env_spaces,
+    make_env,
+)
+from ray_tpu.rllib.rl_module import ContinuousRLModule, RLModule
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -144,4 +149,107 @@ class EnvRunner:
                 ep_ret += r
                 done = term or trunc
             total.append(ep_ret)
+        self._reset_sampling_state()
+        return float(np.mean(total))
+
+    def _reset_sampling_state(self):
+        """Evaluation drove the shared env past the sampler's cursor; start
+        a fresh episode so the next sample() doesn't pair a stale obs with a
+        step from the eval episode's terminal state."""
+        self._obs, _ = self.env.reset()
+        self._episode_return = 0.0
+        self._episode_len = 0
+
+
+class ContinuousEnvRunner:
+    """Sampling actor for continuous control (TD3/DDPG): gaussian
+    exploration noise around the deterministic actor, (s, a, r, s', done)
+    transitions only — off-policy learners need no logp/value traces."""
+
+    def __init__(self, env_spec: Any, env_config: Optional[dict],
+                 module_kwargs: Dict, seed: int = 0,
+                 noise_scale: float = 0.1, warmup_steps: int = 500):
+        import jax
+
+        self.env = make_env(env_spec, env_config)
+        obs_shape = env_obs_shape(self.env)
+        info = env_action_info(self.env)
+        assert info["kind"] == "continuous", info
+        self.module = ContinuousRLModule(obs_shape, info, seed=seed,
+                                         **module_kwargs)
+        self.noise_scale = noise_scale
+        self.warmup_steps = warmup_steps  # uniform-random before learning
+        self._steps = 0
+        self._rng = np.random.default_rng(seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self._completed: list = []
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+        return True
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        import jax
+
+        obs_buf, act_buf, rew_buf, done_buf, next_obs_buf = [], [], [], [], []
+        low, high = self.module.low, self.module.high
+        for _ in range(num_steps):
+            if self._steps < self.warmup_steps:
+                action = self._rng.uniform(low, high).astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                action = self.module.action_exploration(
+                    np.asarray(self._obs, np.float32)[None, :], sub,
+                    self.noise_scale,
+                )[0]
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            obs_buf.append(self._obs)
+            act_buf.append(action)
+            rew_buf.append(reward)
+            done_buf.append(terminated)  # truncation still bootstraps
+            next_obs_buf.append(nxt)
+            self._steps += 1
+            self._episode_return += reward
+            self._episode_len += 1
+            if terminated or truncated:
+                self._completed.append(
+                    {"return": self._episode_return, "len": self._episode_len}
+                )
+                self._episode_return = 0.0
+                self._episode_len = 0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return SampleBatch(
+            {
+                sb.OBS: np.asarray(obs_buf, np.float32),
+                sb.NEXT_OBS: np.asarray(next_obs_buf, np.float32),
+                sb.ACTIONS: np.asarray(act_buf, np.float32),
+                sb.REWARDS: np.asarray(rew_buf, np.float32),
+                sb.DONES: np.asarray(done_buf, np.bool_),
+            }
+        )
+
+    get_metrics = EnvRunner.get_metrics
+    _reset_sampling_state = EnvRunner._reset_sampling_state
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        total = []
+        for _ in range(num_episodes):
+            obs, _ = self.env.reset()
+            ep_ret, done = 0.0, False
+            while not done:
+                a = self.module.action_greedy(
+                    np.asarray(obs, np.float32)[None, :]
+                )[0]
+                obs, r, term, trunc, _ = self.env.step(a)
+                ep_ret += r
+                done = term or trunc
+            total.append(ep_ret)
+        # off-policy: a corrupt transition would persist in the replay
+        # buffer, so restarting the sampler episode matters doubly here
+        self._reset_sampling_state()
         return float(np.mean(total))

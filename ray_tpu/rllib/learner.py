@@ -247,6 +247,147 @@ class DQNLearner(Learner):
         self.target_params = jax.tree.map(jnp.copy, self.module.params)
 
 
+class TD3Learner(Learner):
+    """TD3 (Fujimoto et al. 2018) — and, with ``twin_q=False,
+    policy_delay=1, target_noise=0``, plain DDPG (Lillicrap et al. 2015).
+    Reference analog: rllib/algorithms/td3 and /ddpg (torch policies);
+    here the critic step, delayed actor step, and polyak target updates
+    compile into two jitted functions.
+
+    Expects a ContinuousRLModule (params: actor/q1/q2)."""
+
+    def __init__(self, module, config):
+        # Learner.__init__ builds one tx over module.params; TD3 needs
+        # separate actor/critic optimizers, so set up by hand.
+        self.module = module
+        self.config = config
+        gamma = config.gamma
+        tau = getattr(config, "tau", 0.005)
+        self.twin_q = getattr(config, "twin_q", True)
+        self.policy_delay = max(1, int(getattr(config, "policy_delay", 2)))
+        target_noise = getattr(config, "target_noise", 0.2)
+        noise_clip = getattr(config, "target_noise_clip", 0.5)
+        low = jnp.asarray(module.low)
+        high = jnp.asarray(module.high)
+        actor, critic = module.actor, module.critic
+        twin_q = self.twin_q
+
+        clip = optax.clip_by_global_norm(config.grad_clip or 1e9)
+        self.actor_tx = optax.chain(
+            clip, optax.adam(getattr(config, "actor_lr", config.lr))
+        )
+        self.critic_tx = optax.chain(
+            clip, optax.adam(getattr(config, "critic_lr", config.lr))
+        )
+        self.actor_opt = self.actor_tx.init(module.params["actor"])
+        critic_params = {"q1": module.params["q1"], "q2": module.params["q2"]}
+        self.critic_opt = self.critic_tx.init(critic_params)
+        self.target_params = jax.tree.map(jnp.copy, module.params)
+        self._updates = 0
+
+        def critic_loss_fn(cp, target, mb, key):
+            # target policy smoothing: act from the target actor + clipped
+            # noise, then clipped double-Q target
+            a_next = actor.apply({"params": target["actor"]}, mb[sb.NEXT_OBS])
+            if target_noise > 0:
+                noise = jnp.clip(
+                    jax.random.normal(key, a_next.shape) * target_noise,
+                    -noise_clip, noise_clip,
+                ) * (high - low) * 0.5
+                a_next = jnp.clip(a_next + noise, low, high)
+            tq1 = critic.apply({"params": target["q1"]}, mb[sb.NEXT_OBS], a_next)
+            if twin_q:
+                tq2 = critic.apply(
+                    {"params": target["q2"]}, mb[sb.NEXT_OBS], a_next
+                )
+                tq = jnp.minimum(tq1, tq2)
+            else:
+                tq = tq1
+            y = mb[sb.REWARDS] + gamma * (
+                1.0 - mb[sb.DONES].astype(jnp.float32)
+            ) * tq
+            y = jax.lax.stop_gradient(y)
+            act = mb[sb.ACTIONS].astype(jnp.float32)
+            q1 = critic.apply({"params": cp["q1"]}, mb[sb.OBS], act)
+            loss = ((q1 - y) ** 2).mean()
+            if twin_q:
+                q2 = critic.apply({"params": cp["q2"]}, mb[sb.OBS], act)
+                loss = loss + ((q2 - y) ** 2).mean()
+            return loss
+
+        def critic_step(params, target, critic_opt, mb, key):
+            cp = {"q1": params["q1"], "q2": params["q2"]}
+            loss, grads = jax.value_and_grad(critic_loss_fn)(
+                cp, target, mb, key
+            )
+            updates, critic_opt = self.critic_tx.update(grads, critic_opt, cp)
+            cp = optax.apply_updates(cp, updates)
+            params = {"actor": params["actor"], "q1": cp["q1"], "q2": cp["q2"]}
+            return params, critic_opt, loss
+
+        def actor_loss_fn(ap, params, mb):
+            a = actor.apply({"params": ap}, mb[sb.OBS])
+            return -critic.apply({"params": params["q1"]}, mb[sb.OBS], a).mean()
+
+        def actor_step(params, target, actor_opt, mb):
+            loss, grads = jax.value_and_grad(actor_loss_fn)(
+                params["actor"], params, mb
+            )
+            updates, actor_opt = self.actor_tx.update(
+                grads, actor_opt, params["actor"]
+            )
+            params = dict(params, actor=optax.apply_updates(
+                params["actor"], updates
+            ))
+            # polyak targets move only on actor (delayed) steps, as in TD3
+            target = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target, params
+            )
+            return params, target, actor_opt, loss
+
+        self._critic_step = jax.jit(critic_step)
+        self._actor_step = jax.jit(actor_step)
+        self._key = jax.random.PRNGKey(getattr(config, "seed", 0) + 7)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()
+               if k in (sb.OBS, sb.NEXT_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES)}
+        self._key, sub = jax.random.split(self._key)
+        self.module.params, self.critic_opt, c_loss = self._critic_step(
+            self.module.params, self.target_params, self.critic_opt, jmb, sub
+        )
+        metrics = {"critic_loss": float(c_loss)}
+        self._updates += 1
+        if self._updates % self.policy_delay == 0:
+            (self.module.params, self.target_params,
+             self.actor_opt, a_loss) = self._actor_step(
+                self.module.params, self.target_params, self.actor_opt, jmb
+            )
+            metrics["actor_loss"] = float(a_loss)
+        return metrics
+
+    def get_optimizer_state(self):
+        return {
+            "actor": self.actor_opt,
+            "critic": self.critic_opt,
+            "target_params": self.target_params,
+            "updates": self._updates,
+        }
+
+    def set_optimizer_state(self, state):
+        if state is None:
+            self.actor_opt = self.actor_tx.init(self.module.params["actor"])
+            cp = {"q1": self.module.params["q1"], "q2": self.module.params["q2"]}
+            self.critic_opt = self.critic_tx.init(cp)
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+            self._updates = 0
+            return
+        self.actor_opt = state["actor"]
+        self.critic_opt = state["critic"]
+        self.target_params = state["target_params"]
+        self._updates = state.get("updates", 0)
+
+
 class _TwinQ(nn.Module):
     """Two independent per-action Q MLPs (discrete SAC's clipped double-Q;
     reference analog: rllib/algorithms/sac — torch twin Q towers)."""

@@ -32,6 +32,88 @@ class DiscreteActorCritic(nn.Module):
         return logits, value
 
 
+class ContinuousActor(nn.Module):
+    """Deterministic policy: MLP -> tanh, rescaled into [low, high]
+    (ray parity: DDPG/TD3 actor nets in rllib/algorithms/ddpg|td3)."""
+
+    action_dim: int
+    low: tuple
+    high: tuple
+    hiddens: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        raw = nn.tanh(nn.Dense(self.action_dim, name="mu")(x))
+        low = jnp.asarray(self.low)
+        high = jnp.asarray(self.high)
+        return low + (raw + 1.0) * 0.5 * (high - low)
+
+
+class ContinuousQ(nn.Module):
+    """Q(s, a) critic MLP over the concatenated obs+action."""
+
+    hiddens: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.Dense(1, name="q")(x)[..., 0]
+
+
+class ContinuousRLModule:
+    """Actor + twin critics for continuous control (TD3/DDPG).
+
+    Same role as RLModule for the discrete stack: pure-functional flax
+    nets with jitted inference; the learner owns targets and updates."""
+
+    def __init__(self, obs_shape: tuple, action_info: dict,
+                 hiddens: Sequence[int] = (64, 64), seed: int = 0):
+        self.obs_shape = obs_shape
+        self.action_dim = action_info["dim"]
+        self.low = np.asarray(action_info["low"], np.float32)
+        self.high = np.asarray(action_info["high"], np.float32)
+        self.actor = ContinuousActor(
+            self.action_dim, tuple(self.low.tolist()),
+            tuple(self.high.tolist()), tuple(hiddens),
+        )
+        self.critic = ContinuousQ(tuple(hiddens))
+        k_actor, k_q1, k_q2 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        dummy_obs = jnp.zeros((1, *obs_shape), jnp.float32)
+        dummy_act = jnp.zeros((1, self.action_dim), jnp.float32)
+        self.params = {
+            "actor": self.actor.init(k_actor, dummy_obs)["params"],
+            "q1": self.critic.init(k_q1, dummy_obs, dummy_act)["params"],
+            "q2": self.critic.init(k_q2, dummy_obs, dummy_act)["params"],
+        }
+
+        def act_fn(actor_params, obs):
+            return self.actor.apply({"params": actor_params}, obs)
+
+        self._act = jax.jit(act_fn)
+
+    def action_greedy(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._act(self.params["actor"], obs))
+
+    def action_exploration(self, obs: np.ndarray, key,
+                           noise_scale: float = 0.1) -> np.ndarray:
+        a = self._act(self.params["actor"], obs)
+        noise = jax.random.normal(key, a.shape) * noise_scale * (
+            (self.high - self.low) * 0.5
+        )
+        return np.asarray(jnp.clip(a + noise, self.low, self.high))
+
+    def get_state(self) -> Dict[str, Any]:
+        return jax.device_get(self.params)
+
+    def set_state(self, params):
+        self.params = jax.device_put(params)
+
+
 class RLModule:
     """Bundles a flax module + param pytree with jitted inference ops."""
 

@@ -1,0 +1,60 @@
+"""Continuous control: TD3/DDPG learning regression on Reacher1D-native
+(ray parity: rllib/algorithms/td3, /ddpg — tuned_examples-style check)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import DDPGConfig, TD3Config
+
+
+def _train(config_cls, iters, **training):
+    cfg = (
+        config_cls()
+        .environment("Reacher1D-native")
+        .env_runners(num_env_runners=1, rollout_fragment_length=240)
+        .training(**training)
+        .debugging(seed=1)
+    )
+    algo = cfg.build()
+    last = {}
+    returns = []
+    for _ in range(iters):
+        last = algo.train()
+        if "episode_return_mean" in last:
+            returns.append(last["episode_return_mean"])
+    score = algo.evaluate()["evaluation"]["episode_return_mean"]
+    ckpt = algo.save_checkpoint()
+    return score, returns, ckpt, algo
+
+
+def test_td3_learns_reacher(ray_start_regular):
+    score, returns, ckpt, algo = _train(
+        TD3Config, iters=8, warmup_steps=300,
+        num_steps_sampled_before_learning=300, num_epochs=30,
+    )
+    try:
+        # Random policy averages ~ -20 per 60-step episode; a trained actor
+        # that homes in on the target stays above -8.
+        assert score > -8.0, (score, returns)
+        # checkpoint roundtrip keeps the trained actor (runners still live:
+        # load_checkpoint re-syncs weights to them)
+        algo.load_checkpoint(ckpt)
+        a = algo.compute_single_action(np.array([0.5, -0.5], np.float32))
+        assert a.shape == (1,) and -1.0 <= float(a[0]) <= 1.0
+    finally:
+        algo.cleanup()
+
+
+def test_ddpg_runs_and_improves(ray_start_regular):
+    score, returns, _, algo = _train(
+        DDPGConfig, iters=6, warmup_steps=300,
+        num_steps_sampled_before_learning=300, num_epochs=25,
+    )
+    algo.cleanup()
+    assert score > -12.0, (score, returns)
+
+
+def test_td3_rejects_discrete_env(ray_start_regular):
+    cfg = TD3Config().environment("CartPole-native")
+    with pytest.raises(ValueError, match="continuous"):
+        cfg.build().train()
