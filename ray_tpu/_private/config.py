@@ -114,6 +114,19 @@ _flag("event_stats", bool, True)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)
+# RPC substrate (ray: grpc_server.h / client channel args)
+_flag("rpc_max_message_bytes", int, 1 << 31)
+_flag("rpc_auth_timeout_s", float, 10.0)
+_flag("rpc_connect_retries", int, 30)
+_flag("rpc_connect_retry_delay_s", float, 0.1)
+# Serve (ray: serve/_private defaults)
+_flag("serve_control_loop_period_s", float, 0.25)
+_flag("serve_default_graceful_shutdown_timeout_s", float, 5.0)
+# Tune (ray: tune/execution/experiment_state.py checkpoint period)
+_flag("tune_experiment_snapshot_period_s", float, 10.0)
+# Train (ray: train/_internal/backend_executor timeouts)
+_flag("train_worker_start_timeout_s", float, 300.0)
+_flag("train_result_poll_timeout_s", float, 900.0)
 
 
 GLOBAL_CONFIG = _Config()

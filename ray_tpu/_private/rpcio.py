@@ -31,7 +31,12 @@ KIND_ERR = 2
 KIND_NOTIFY = 3
 
 _HDR = 4
-_MAX_MSG = 1 << 31
+
+
+def _max_msg() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.rpc_max_message_bytes
 
 # --- connection authentication -----------------------------------------
 # Frames are pickles, and unpickling executes code — so no frame may be
@@ -47,7 +52,6 @@ _MAX_MSG = 1 << 31
 
 _AUTH_MAGIC = b"RTPU1"
 _AUTH_LEN = len(_AUTH_MAGIC) + 64
-_AUTH_TIMEOUT = 10.0
 
 
 def cluster_token() -> str:
@@ -120,7 +124,7 @@ class Connection:
             while True:
                 hdr = await self.reader.readexactly(_HDR)
                 n = int.from_bytes(hdr, "little")
-                if n > _MAX_MSG:
+                if n > _max_msg():
                     raise RpcError(f"oversized message: {n}")
                 data = await self.reader.readexactly(n)
                 msg_id, kind, method, payload = pickle.loads(data)
@@ -218,9 +222,11 @@ class RpcServer:
         return self.port
 
     async def _accept(self, reader, writer):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         try:
             preamble = await asyncio.wait_for(
-                reader.readexactly(_AUTH_LEN), _AUTH_TIMEOUT
+                reader.readexactly(_AUTH_LEN), GLOBAL_CONFIG.rpc_auth_timeout_s
             )
         except Exception:
             writer.close()
@@ -255,7 +261,13 @@ class RpcServer:
 
 
 async def connect(host: str, port: int, handler=None, name: str = "client",
-                  retries: int = 30, retry_delay: float = 0.1) -> Connection:
+                  retries: int = None, retry_delay: float = None) -> Connection:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if retries is None:
+        retries = GLOBAL_CONFIG.rpc_connect_retries
+    if retry_delay is None:
+        retry_delay = GLOBAL_CONFIG.rpc_connect_retry_delay_s
     last = None
     for _ in range(retries):
         try:

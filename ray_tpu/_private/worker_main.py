@@ -9,6 +9,56 @@ import os
 import threading
 
 
+def _pin_jax_platform_on_import(platforms: str):
+    """Arrange for jax.config.update("jax_platforms", ...) to run right
+    after jax finishes importing — wherever that import happens. If jax is
+    already in (e.g. a sitecustomize imported it at interpreter start),
+    pin immediately."""
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            sys.modules["jax"].config.update("jax_platforms", platforms)
+        except Exception:
+            pass
+        return
+
+    import importlib.abc
+    import importlib.util
+
+    class _Finder(importlib.abc.MetaPathFinder):
+        def __init__(self):
+            self._busy = False
+
+        def find_spec(self, name, path=None, target=None):
+            if name != "jax" or self._busy:
+                return None
+            self._busy = True  # find_spec below re-enters the meta path
+            try:
+                spec = importlib.util.find_spec("jax")
+            finally:
+                self._busy = False
+            if spec is None or spec.loader is None:
+                return None
+            orig_loader = spec.loader
+
+            class _Loader(importlib.abc.Loader):
+                def create_module(self, spec):
+                    return orig_loader.create_module(spec)
+
+                def exec_module(self, module):
+                    orig_loader.exec_module(module)
+                    try:
+                        module.config.update("jax_platforms", platforms)
+                    except Exception:
+                        pass
+
+            spec.loader = _Loader()
+            return spec
+
+    sys.meta_path.insert(0, _Finder())
+
+
 def main():
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
@@ -30,27 +80,22 @@ def main():
     # Materialize this worker's runtime env (working_dir/py_modules URIs)
     # BEFORE attaching the executor: the pool keys workers by env hash, so
     # every task routed here expects the env to be in place.
+    import json
+
     renv = os.environ.get("RAY_TPU_RUNTIME_ENV")
     if renv:
-        import json
-
         from ray_tpu._private.runtime_env import materialize
 
         materialize(cw, json.loads(renv))
 
     # The JAX_PLATFORMS env var alone does not stop plugin backends (e.g.
     # the axon TPU tunnel) from initializing — a dead tunnel then hangs the
-    # first dispatch indefinitely. jax.config.update IS honored, so when the
-    # runtime_env pinned a platform for this worker, assert it through the
-    # config API before any user code touches jax. Runs AFTER runtime-env
-    # materialization so a jax shipped via py_modules is the one imported.
+    # first dispatch indefinitely. jax.config.update IS honored, so pin the
+    # platform through the config API the moment jax is imported (a lazy
+    # post-import hook: jax-free workers never pay the import; a jax
+    # shipped via py_modules wins because materialization already ran).
     if os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+        _pin_jax_platform_on_import(os.environ["JAX_PLATFORMS"])
 
     TaskExecutor(cw)
     global_worker.core_worker = cw

@@ -143,13 +143,11 @@ class GPT2(nn.Module):
         return logits
 
 
-def loss_fn(params, model, batch):
-    logits = model.apply({"params": params}, batch["input_ids"])
-    labels = batch["labels"]
-    # Fused cross-entropy: ll = logit[label] - logsumexp(logits). Never
-    # materializes log_softmax over the vocab (a B*T*50257 f32 tensor is
-    # ~1.6GB at batch 8 — pure HBM-bandwidth waste); the max/sum reductions
-    # fuse into a single read of the bf16 logits with f32 accumulation.
+def fused_xent(logits, labels, mask=None):
+    """Fused cross-entropy: ll = logit[label] - logsumexp(logits). Never
+    materializes log_softmax over the vocab (a B*T*50257 f32 tensor is
+    ~1.6GB at batch 8 — pure HBM-bandwidth waste); the max/sum reductions
+    fuse into a single read of the bf16 logits with f32 accumulation."""
     lmax = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
     # upcast BEFORE subtracting: the bf16→f32 cast is free next to the
     # reduction, and the f32 subtraction is exact (bf16 would round the
@@ -160,14 +158,18 @@ def loss_fn(params, model, batch):
         shifted, labels[..., None], axis=-1
     )[..., 0]
     ll = label_logit - lse
-    mask = batch.get("mask")
     if mask is None:
         return -ll.mean()
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
-def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
-                     weight_decay: float = 0.1):
+def loss_fn(params, model, batch):
+    logits = model.apply({"params": params}, batch["input_ids"])
+    return fused_xent(logits, batch["labels"], batch.get("mask"))
+
+
+def init_params(config: GPT2Config, rng):
+    """Model + freshly initialized params (no optimizer state)."""
     model = GPT2(config)
     dummy = jnp.zeros((1, min(8, config.n_positions)), dtype=jnp.int32)
     init_model = model
@@ -175,10 +177,21 @@ def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
         # ring attention needs a bound mesh axis; param shapes don't depend
         # on the attention impl, so initialize outside shard_map without it
         init_model = GPT2(dataclasses.replace(config, attention="auto"))
-    params = init_model.init(rng, dummy)["params"]
-    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay)
-    opt_state = tx.init(params)
-    return model, params, tx, opt_state
+    return model, init_model.init(rng, dummy)["params"]
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1):
+    """The one adamw recipe every train-state builder shares — PP runs are
+    loss-matched against DP runs, so the hyperparams must not fork."""
+    return optax.adamw(learning_rate, b1=0.9, b2=0.95,
+                       weight_decay=weight_decay)
+
+
+def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
+                     weight_decay: float = 0.1):
+    model, params = init_params(config, rng)
+    tx = make_optimizer(learning_rate, weight_decay)
+    return model, params, tx, tx.init(params)
 
 
 def build_train_step(model, tx, donate: bool = True):
@@ -321,6 +334,161 @@ def shard_train_state_tp(params, opt_state, mesh: Mesh,
 
     opt_state = jax.tree.map(place, opt_state, is_leaf=is_params_like)
     return params, opt_state
+
+
+def make_pipeline_train_state(config: GPT2Config, rng, n_stages: int,
+                              learning_rate: float = 3e-4,
+                              weight_decay: float = 0.1):
+    """Pipeline-parallel train state: the transformer blocks are regrouped
+    into ``n_stages`` stages with a leading (stage, layers_per_stage) axis
+    pair (shard the stage axis over the ``pipeline`` mesh axis); embeddings
+    and the final layernorm stay replicated (they run on every pipeline
+    rank; their grads are completed by a psum — see build_train_step_pp).
+
+    Initialized from the SAME init as make_train_state, so a PP run is
+    numerically comparable to the DP run of the same seed."""
+    from ray_tpu.parallel.pipeline import stack_stage_params
+
+    if config.n_layer % n_stages != 0:
+        raise ValueError(f"n_layer={config.n_layer} not divisible by "
+                         f"n_stages={n_stages}")
+    per_stage = config.n_layer // n_stages
+    _, params = init_params(config, rng)
+    blocks = [params[f"h_{i}"] for i in range(config.n_layer)]
+    stages = stack_stage_params([
+        stack_stage_params(blocks[s * per_stage:(s + 1) * per_stage])
+        for s in range(n_stages)
+    ])
+    pp_params = {
+        "stages": stages,
+        "embed": {
+            "wte": params["wte"], "wpe": params["wpe"],
+            "ln_f": params["ln_f"],
+        },
+    }
+    tx = make_optimizer(learning_rate, weight_decay)
+    return pp_params, tx, tx.init(pp_params)
+
+
+def shard_pipeline_state(pp_params, opt_state, mesh: Mesh,
+                         axis: str = "pipeline"):
+    """Place PP params + optimizer moments: stage leaves sharded over the
+    pipeline axis (leading dim), everything else replicated."""
+    from ray_tpu.parallel.mesh_utils import replicated
+
+    def sharding_tree(tree):
+        stage_sh = NamedSharding(mesh, PartitionSpec(axis))
+        rep = replicated(mesh)
+        return {
+            "stages": jax.tree.map(lambda _: stage_sh, tree["stages"]),
+            "embed": jax.tree.map(lambda _: rep, tree["embed"]),
+        }
+
+    p_sh = sharding_tree(pp_params)
+    pp_params = jax.tree.map(jax.device_put, pp_params, p_sh)
+    p_treedef = jax.tree_util.tree_structure(pp_params)
+
+    def is_params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == p_treedef
+        except Exception:
+            return False
+
+    def place(node):
+        if is_params_like(node):
+            return jax.tree.map(jax.device_put, node, p_sh)
+        return jax.tree.map(lambda l: jax.device_put(l, replicated(mesh)), node)
+
+    opt_state = jax.tree.map(place, opt_state, is_leaf=is_params_like)
+    return pp_params, opt_state
+
+
+def build_train_step_pp(config: GPT2Config, tx, mesh: Mesh, *,
+                        n_microbatches: int, axis: str = "pipeline",
+                        batch_axis: str = "data", donate: bool = True):
+    """Pipelined train step over a (data, pipeline) mesh.
+
+    Inside shard_map, each pipeline rank embeds the (replicated-within-
+    pipeline, sharded-over-data) batch, runs its OWN stage of blocks in the
+    ppermute pipeline (ray_tpu.parallel.pipeline), and the LAST rank's
+    head + loss is broadcast back with a psum. Grad bookkeeping:
+    - stage grads arrive complete on their owning rank (cotangents routed
+      by the reverse ppermute chain) — no pipeline reduction;
+    - replicated embed/head grads are partial per rank (loss path lands on
+      the last rank, the injection path on rank 0) — a psum over the
+      pipeline axis completes them;
+    - everything is then pmean'd over the data axis (plain DP).
+    """
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    block = Block(config)
+    ln_f = nn.LayerNorm(dtype=config.dtype)
+
+    def stage_fn(stage_params, x):
+        def body(h, p):
+            return block.apply({"params": p}, h), None
+
+        h, _ = jax.lax.scan(body, x, stage_params)
+        return h
+
+    def local_grads(params, batch):
+        ids, labels = batch["input_ids"], batch["labels"]
+        B, T = ids.shape
+        M = n_microbatches
+        assert B % M == 0, (B, M)
+
+        def loss_of(params):
+            emb = params["embed"]
+            x = (emb["wte"]["embedding"][ids]
+                 + emb["wpe"]["embedding"][jnp.arange(T)][None])
+            x = x.astype(config.dtype)
+            mb = x.reshape(M, B // M, T, x.shape[-1])
+            own = jax.tree.map(lambda p: p[0], params["stages"])
+            y = pipeline_apply(stage_fn, own, mb, axis_name=axis)
+            y = y.reshape(B, T, -1).astype(config.dtype)
+            y = ln_f.apply({"params": emb["ln_f"]}, y)
+            logits = y @ emb["wte"]["embedding"].astype(config.dtype).T
+            raw = fused_xent(logits, labels, batch.get("mask"))
+            # only the LAST rank's loss counts (psum broadcasts it): this
+            # pins the head/loss grad path to one rank so the psum over
+            # the pipeline axis below completes replicated-param grads
+            # exactly once
+            is_last = jax.lax.axis_index(axis) == jax.lax.axis_size(axis) - 1
+            return jax.lax.psum(jnp.where(is_last, raw, 0.0), axis)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        grads = {
+            "stages": grads["stages"],
+            "embed": jax.lax.psum(grads["embed"], axis),
+        }
+        grads = jax.lax.pmean(grads, batch_axis)
+        return jax.lax.pmean(loss, batch_axis), grads
+
+    param_specs = {
+        "stages": PartitionSpec(axis),
+        "embed": PartitionSpec(),
+    }
+    # single spec = pytree prefix: every batch leaf (input_ids, labels,
+    # optional mask) shards its leading batch dim over the data axis
+    bspec = PartitionSpec(batch_axis)
+    grad_fn = shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(param_specs, bspec),
+        out_specs=(PartitionSpec(), param_specs),
+    )
+
+    def step(params, opt_state, batch):
+        loss, grads = grad_fn(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
 def shard_batch(batch, mesh: Mesh):

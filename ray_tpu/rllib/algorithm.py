@@ -356,15 +356,17 @@ class TD3(Algorithm):
 
         cfg = self._algo_config
         probe = make_env(cfg.env, cfg.env_config)
-        obs_shape = env_obs_shape(probe)
-        action_info = env_action_info(probe)
-        if action_info["kind"] != "continuous":
-            raise ValueError(
-                f"TD3/DDPG need a continuous action space; {cfg.env!r} is "
-                f"{action_info['kind']}"
-            )
-        if hasattr(probe, "close"):
-            probe.close()
+        try:
+            obs_shape = env_obs_shape(probe)
+            action_info = env_action_info(probe)
+            if action_info["kind"] != "continuous":
+                raise ValueError(
+                    f"TD3/DDPG need a continuous action space; {cfg.env!r} "
+                    f"is {action_info['kind']}"
+                )
+        finally:
+            if hasattr(probe, "close"):
+                probe.close()
         hiddens = tuple(cfg.model.get("hiddens", (64, 64)))
         self.module = ContinuousRLModule(
             obs_shape, action_info, hiddens=hiddens, seed=cfg.seed

@@ -1,9 +1,11 @@
-"""EnvRunner: the sampling-plane actor.
+"""EnvRunner: the sampling-plane actors.
 
 Reference parity: ray rllib/evaluation/rollout_worker.py:660 (sample) /
-rllib/env/env_runner.py — an actor stepping one env with the current
-policy, returning fixed-size rollout fragments with log-probs and value
-estimates attached (what PPO/IMPALA need), plus episode-return metrics.
+rllib/env/env_runner.py — actors stepping one env with the current policy
+and returning fixed-size rollout fragments plus episode-return metrics.
+``EnvRunner`` serves the discrete on-policy stack (log-probs + value
+estimates for PPO/IMPALA); ``ContinuousEnvRunner`` serves TD3/DDPG
+(deterministic actor + gaussian exploration, plain transitions).
 """
 
 from __future__ import annotations
@@ -23,15 +25,14 @@ from ray_tpu.rllib.rl_module import ContinuousRLModule, RLModule
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
-class EnvRunner:
+class _RunnerBase:
+    """Shared env ownership + episode accounting + greedy evaluation."""
+
     def __init__(self, env_spec: Any, env_config: Optional[dict],
-                 module_kwargs: Dict, seed: int = 0):
+                 seed: int = 0):
         import jax
 
         self.env = make_env(env_spec, env_config)
-        obs_shape, num_actions = env_spaces(self.env)
-        self.module = RLModule(obs_shape, num_actions, seed=seed,
-                               **module_kwargs)
         self._key = jax.random.PRNGKey(seed)
         self._obs, _ = self.env.reset(seed=seed)
         self._episode_return = 0.0
@@ -44,6 +45,75 @@ class EnvRunner:
 
     def get_weights(self):
         return self.module.get_state()
+
+    def _end_step(self, reward, terminated, truncated, nxt):
+        """Advance episode accounting after one env step; returns True if
+        an episode boundary was crossed (env already reset)."""
+        self._episode_return += reward
+        self._episode_len += 1
+        if terminated or truncated:
+            self._completed.append(
+                {"return": self._episode_return, "len": self._episode_len}
+            )
+            self._episode_return = 0.0
+            self._episode_len = 0
+            self._obs, _ = self.env.reset()
+            return True
+        self._obs = nxt
+        return False
+
+    def get_metrics(self) -> Dict[str, float]:
+        eps, self._completed = self._completed, []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        returns = [e["return"] for e in eps]
+        return {
+            "episodes_this_iter": len(eps),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean([e["len"] for e in eps])),
+        }
+
+    def _reset_sampling_state(self):
+        """Evaluation drove the shared env past the sampler's cursor; start
+        a fresh episode so the next sample() doesn't pair a stale obs with a
+        step from the eval episode's terminal state (for off-policy runners
+        a corrupt transition would persist in the replay buffer)."""
+        self._obs, _ = self.env.reset()
+        self._episode_return = 0.0
+        self._episode_len = 0
+
+    def _eval_action(self, obs):
+        raise NotImplementedError
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Greedy policy evaluation, returns mean episode return."""
+        total = []
+        for _ in range(num_episodes):
+            obs, _ = self.env.reset()
+            ep_ret, done = 0.0, False
+            while not done:
+                obs, r, term, trunc, _ = self.env.step(self._eval_action(obs))
+                ep_ret += r
+                done = term or trunc
+            total.append(ep_ret)
+        self._reset_sampling_state()
+        return float(np.mean(total))
+
+
+class EnvRunner(_RunnerBase):
+    def __init__(self, env_spec: Any, env_config: Optional[dict],
+                 module_kwargs: Dict, seed: int = 0):
+        super().__init__(env_spec, env_config, seed)
+        obs_shape, num_actions = env_spaces(self.env)
+        self.module = RLModule(obs_shape, num_actions, seed=seed,
+                               **module_kwargs)
+
+    def _eval_action(self, obs):
+        return int(self.module.action_greedy(
+            np.asarray(obs, np.float32)[None, :]
+        )[0])
 
     def _value_of(self, obs) -> float:
         import jax
@@ -85,17 +155,7 @@ class EnvRunner:
                 vf_next_buf.append(self._value_of(nxt))
             else:
                 vf_next_buf.append(np.nan)  # = values[t+1], filled below
-            self._episode_return += reward
-            self._episode_len += 1
-            if terminated or truncated:
-                self._completed.append(
-                    {"return": self._episode_return, "len": self._episode_len}
-                )
-                self._episode_return = 0.0
-                self._episode_len = 0
-                self._obs, _ = self.env.reset()
-            else:
-                self._obs = nxt
+            self._end_step(reward, terminated, truncated, nxt)
         values = np.asarray(val_buf, np.float32)
         vf_next = np.asarray(vf_next_buf, np.float32)
         # Fill mid-episode steps with the next step's on-policy value; the
@@ -124,44 +184,8 @@ class EnvRunner:
         )
         return batch
 
-    def get_metrics(self) -> Dict[str, float]:
-        eps, self._completed = self._completed, []
-        if not eps:
-            return {"episodes_this_iter": 0}
-        returns = [e["return"] for e in eps]
-        return {
-            "episodes_this_iter": len(eps),
-            "episode_return_mean": float(np.mean(returns)),
-            "episode_return_max": float(np.max(returns)),
-            "episode_return_min": float(np.min(returns)),
-            "episode_len_mean": float(np.mean([e["len"] for e in eps])),
-        }
 
-    def evaluate(self, num_episodes: int = 5) -> float:
-        """Greedy policy evaluation, returns mean episode return."""
-        total = []
-        for _ in range(num_episodes):
-            obs, _ = self.env.reset()
-            ep_ret, done = 0.0, False
-            while not done:
-                a = self.module.action_greedy(obs[None, :])
-                obs, r, term, trunc, _ = self.env.step(int(a[0]))
-                ep_ret += r
-                done = term or trunc
-            total.append(ep_ret)
-        self._reset_sampling_state()
-        return float(np.mean(total))
-
-    def _reset_sampling_state(self):
-        """Evaluation drove the shared env past the sampler's cursor; start
-        a fresh episode so the next sample() doesn't pair a stale obs with a
-        step from the eval episode's terminal state."""
-        self._obs, _ = self.env.reset()
-        self._episode_return = 0.0
-        self._episode_len = 0
-
-
-class ContinuousEnvRunner:
+class ContinuousEnvRunner(_RunnerBase):
     """Sampling actor for continuous control (TD3/DDPG): gaussian
     exploration noise around the deterministic actor, (s, a, r, s', done)
     transitions only — off-policy learners need no logp/value traces."""
@@ -169,9 +193,7 @@ class ContinuousEnvRunner:
     def __init__(self, env_spec: Any, env_config: Optional[dict],
                  module_kwargs: Dict, seed: int = 0,
                  noise_scale: float = 0.1, warmup_steps: int = 500):
-        import jax
-
-        self.env = make_env(env_spec, env_config)
+        super().__init__(env_spec, env_config, seed)
         obs_shape = env_obs_shape(self.env)
         info = env_action_info(self.env)
         assert info["kind"] == "continuous", info
@@ -181,15 +203,11 @@ class ContinuousEnvRunner:
         self.warmup_steps = warmup_steps  # uniform-random before learning
         self._steps = 0
         self._rng = np.random.default_rng(seed)
-        self._key = jax.random.PRNGKey(seed)
-        self._obs, _ = self.env.reset(seed=seed)
-        self._episode_return = 0.0
-        self._episode_len = 0
-        self._completed: list = []
 
-    def set_weights(self, params):
-        self.module.set_state(params)
-        return True
+    def _eval_action(self, obs):
+        return self.module.action_greedy(
+            np.asarray(obs, np.float32)[None, :]
+        )[0]
 
     def sample(self, num_steps: int) -> SampleBatch:
         import jax
@@ -212,17 +230,7 @@ class ContinuousEnvRunner:
             done_buf.append(terminated)  # truncation still bootstraps
             next_obs_buf.append(nxt)
             self._steps += 1
-            self._episode_return += reward
-            self._episode_len += 1
-            if terminated or truncated:
-                self._completed.append(
-                    {"return": self._episode_return, "len": self._episode_len}
-                )
-                self._episode_return = 0.0
-                self._episode_len = 0
-                self._obs, _ = self.env.reset()
-            else:
-                self._obs = nxt
+            self._end_step(reward, terminated, truncated, nxt)
         return SampleBatch(
             {
                 sb.OBS: np.asarray(obs_buf, np.float32),
@@ -232,24 +240,3 @@ class ContinuousEnvRunner:
                 sb.DONES: np.asarray(done_buf, np.bool_),
             }
         )
-
-    get_metrics = EnvRunner.get_metrics
-    _reset_sampling_state = EnvRunner._reset_sampling_state
-
-    def evaluate(self, num_episodes: int = 5) -> float:
-        total = []
-        for _ in range(num_episodes):
-            obs, _ = self.env.reset()
-            ep_ret, done = 0.0, False
-            while not done:
-                a = self.module.action_greedy(
-                    np.asarray(obs, np.float32)[None, :]
-                )[0]
-                obs, r, term, trunc, _ = self.env.step(a)
-                ep_ret += r
-                done = term or trunc
-            total.append(ep_ret)
-        # off-policy: a corrupt transition would persist in the replay
-        # buffer, so restarting the sampler episode matters doubly here
-        self._reset_sampling_state()
-        return float(np.mean(total))

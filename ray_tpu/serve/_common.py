@@ -17,6 +17,12 @@ SERVE_NAMESPACE = "serve"
 DEFAULT_APP_NAME = "default"
 
 
+def _default_graceful_shutdown_s() -> float:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.serve_default_graceful_shutdown_timeout_s
+
+
 @dataclass
 class Request:
     """HTTP request envelope delivered to ingress deployments."""
@@ -43,7 +49,9 @@ class DeploymentConfig:
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Optional[Any] = None
     health_check_period_s: float = 10.0
-    graceful_shutdown_timeout_s: float = 5.0
+    graceful_shutdown_timeout_s: float = field(
+        default_factory=_default_graceful_shutdown_s
+    )
 
     def replica_actor_options(self) -> Dict[str, Any]:
         opts = dict(self.ray_actor_options or {})

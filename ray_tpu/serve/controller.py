@@ -24,7 +24,10 @@ from ray_tpu.serve._common import (
 
 logger = logging.getLogger(__name__)
 
-CONTROL_LOOP_PERIOD_S = 0.25
+def _control_loop_period() -> float:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.serve_control_loop_period_s
 
 
 class _DeploymentState:
@@ -188,7 +191,7 @@ class ServeController:
                 self._autoscale_once()
             except Exception:  # noqa: BLE001 — loop must survive
                 logger.exception("serve control loop iteration failed")
-            self._shutdown.wait(CONTROL_LOOP_PERIOD_S)
+            self._shutdown.wait(_control_loop_period())
 
     def _reconcile_once(self):
         import ray_tpu

@@ -15,6 +15,7 @@ import time
 from typing import Callable, List, Optional
 
 import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import CheckpointConfig, RunConfig, ScalingConfig
 from ray_tpu.air.result import Result
@@ -129,7 +130,7 @@ class BackendExecutor:
                     self.trial_dir, checkpoint,
                 )
             )
-        ray_tpu.get(refs, timeout=300)
+        ray_tpu.get(refs, timeout=GLOBAL_CONFIG.train_worker_start_timeout_s)
         self.backend.on_start(self.worker_group, self.backend_config)
 
     # ------------------------------------------------------------------
@@ -141,7 +142,7 @@ class BackendExecutor:
         try:
             ray_tpu.get(
                 [w.start_training.remote(train_fn, config or {}) for w in wg.workers],
-                timeout=300,
+                timeout=GLOBAL_CONFIG.train_worker_start_timeout_s,
             )
         except Exception as e:
             return Result(
@@ -158,7 +159,10 @@ class BackendExecutor:
                 if not done[i]
             ]
             try:
-                results = ray_tpu.get([r for _, r in polls], timeout=900)
+                results = ray_tpu.get(
+                    [r for _, r in polls],
+                    timeout=GLOBAL_CONFIG.train_result_poll_timeout_s,
+                )
             except Exception as e:
                 # A worker actor died mid-training (process exit / node loss).
                 final_error = TrainingFailedError(f"train worker died: {e}")

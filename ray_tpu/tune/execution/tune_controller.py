@@ -130,7 +130,9 @@ class TuneController:
         self._live: Dict[object, tuple] = {}  # future -> (trial, kind)
         self._reusable_actors: List[object] = []
         self._searcher_done = False
-        self._state_interval_s = 10.0
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._state_interval_s = GLOBAL_CONFIG.tune_experiment_snapshot_period_s
         self._last_state_save = 0.0
 
     # ------------------------------------------------------------------
