@@ -50,30 +50,36 @@ def _measure(config_cls, batch_size, seq_len, remat, steps, warmup,
     return batch_size * seq_len * steps / dt
 
 
-def _tpu_reachable(timeout_s: float = 180.0) -> bool:
+def _tpu_reachable(timeout_s: float = 150.0, attempts: int = 3,
+                   retry_wait_s: float = 60.0) -> bool:
     """Probe the accelerator in a subprocess: a dead TPU tunnel makes
     jax.devices() block indefinitely inside the PJRT client, which no
-    in-process timeout can interrupt. A probe that times out means we fall
-    back to the CPU smoke bench instead of hanging the driver."""
+    in-process timeout can interrupt. The tunnel flaps, so a failed probe
+    retries a couple of times before falling back to the CPU smoke bench
+    (a CPU number is ~0.03x and useless as a round record)."""
     import subprocess
 
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=timeout_s, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        print("[bench] TPU probe timed out; falling back to CPU",
-              file=sys.stderr)
-        return False
-    platform = (out.stdout or "").strip().splitlines()[-1:] or [""]
-    ok = out.returncode == 0 and platform[0] not in ("", "cpu")
-    if not ok:
-        print(f"[bench] TPU probe failed (rc={out.returncode}, "
-              f"platform={platform[0]!r}); falling back to CPU",
-              file=sys.stderr)
-    return ok
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, timeout=timeout_s, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"[bench] TPU probe {attempt + 1}/{attempts} timed out",
+                  file=sys.stderr)
+        else:
+            platform = (out.stdout or "").strip().splitlines()[-1:] or [""]
+            if out.returncode == 0 and platform[0] not in ("", "cpu"):
+                return True
+            print(f"[bench] TPU probe {attempt + 1}/{attempts} failed "
+                  f"(rc={out.returncode}, platform={platform[0]!r})",
+                  file=sys.stderr)
+        if attempt + 1 < attempts:
+            time.sleep(retry_wait_s)
+    print("[bench] TPU unreachable; falling back to CPU", file=sys.stderr)
+    return False
 
 
 def main():
@@ -118,14 +124,19 @@ def main():
             best, best_cfg = tps, (batch_size, remat, attention)
 
     baseline = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
-    print(json.dumps({
+    record = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
         "value": round(best, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(best / baseline, 4),
         "config": {"batch_size": best_cfg[0], "remat": best_cfg[1],
                    "attention": best_cfg[2], "seq_len": seq_len},
-    }))
+    }
+    if not on_tpu:
+        # CPU smoke numbers are not comparable to the TPU baseline; mark
+        # the record so a dead tunnel is not read as a perf regression
+        record["degraded"] = "tpu_unreachable_cpu_smoke"
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -81,6 +81,8 @@ class Connection:
         self.writer = writer
         self.handler = handler
         self.name = name
+        # flag read once per connection: the recv loop is the hot path
+        self._max_msg = _max_msg()
         self._pending: Dict[int, asyncio.Future] = {}
         self._msg_ids = itertools.count(1)
         self._send_lock = asyncio.Lock()
@@ -124,7 +126,7 @@ class Connection:
             while True:
                 hdr = await self.reader.readexactly(_HDR)
                 n = int.from_bytes(hdr, "little")
-                if n > _max_msg():
+                if n > self._max_msg:
                     raise RpcError(f"oversized message: {n}")
                 data = await self.reader.readexactly(n)
                 msg_id, kind, method, payload = pickle.loads(data)

@@ -41,6 +41,7 @@ def _pin_jax_platform_on_import(platforms: str):
             if spec is None or spec.loader is None:
                 return None
             orig_loader = spec.loader
+            finder = self
 
             class _Loader(importlib.abc.Loader):
                 def create_module(self, spec):
@@ -48,6 +49,11 @@ def _pin_jax_platform_on_import(platforms: str):
 
                 def exec_module(self, module):
                     orig_loader.exec_module(module)
+                    # one-shot: jax is pinned; stop intercepting imports
+                    try:
+                        sys.meta_path.remove(finder)
+                    except ValueError:
+                        pass
                     try:
                         module.config.update("jax_platforms", platforms)
                     except Exception:

@@ -143,8 +143,8 @@ class GPT2(nn.Module):
         return logits
 
 
-def fused_xent(logits, labels, mask=None):
-    """Fused cross-entropy: ll = logit[label] - logsumexp(logits). Never
+def token_log_likelihood(logits, labels):
+    """Per-token ll = logit[label] - logsumexp(logits), fused: never
     materializes log_softmax over the vocab (a B*T*50257 f32 tensor is
     ~1.6GB at batch 8 — pure HBM-bandwidth waste); the max/sum reductions
     fuse into a single read of the bf16 logits with f32 accumulation."""
@@ -157,7 +157,12 @@ def fused_xent(logits, labels, mask=None):
     label_logit = jnp.take_along_axis(
         shifted, labels[..., None], axis=-1
     )[..., 0]
-    ll = label_logit - lse
+    return label_logit - lse
+
+
+def fused_xent(logits, labels, mask=None):
+    """Masked-mean fused cross-entropy (see token_log_likelihood)."""
+    ll = token_log_likelihood(logits, labels)
     if mask is None:
         return -ll.mean()
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
@@ -453,21 +458,36 @@ def build_train_step_pp(config: GPT2Config, tx, mesh: Mesh, *,
             y = y.reshape(B, T, -1).astype(config.dtype)
             y = ln_f.apply({"params": emb["ln_f"]}, y)
             logits = y @ emb["wte"]["embedding"].astype(config.dtype).T
-            raw = fused_xent(logits, labels, batch.get("mask"))
-            # only the LAST rank's loss counts (psum broadcasts it): this
-            # pins the head/loss grad path to one rank so the psum over
-            # the pipeline axis below completes replicated-param grads
-            # exactly once
+            ll = token_log_likelihood(logits, labels)
+            mask = batch.get("mask")
+            mask = jnp.ones_like(ll) if mask is None else mask
+            # Global token-weighted normalization, like the DP loss_fn over
+            # the full batch: sum the masked ll and the mask count across
+            # the data axis so shards with fewer valid tokens don't get
+            # up-weighted (a pmean of per-shard masked means would).
+            # Masking to the LAST pipeline rank pins the head/loss grad
+            # path to one rank, so the psum over the pipeline axis below
+            # completes replicated-param grads exactly once.
             is_last = jax.lax.axis_index(axis) == jax.lax.axis_size(axis) - 1
-            return jax.lax.psum(jnp.where(is_last, raw, 0.0), axis)
+            numer = jax.lax.psum(
+                jnp.where(is_last, -(ll * mask).sum(), 0.0),
+                (axis, batch_axis),
+            )
+            denom = jax.lax.psum(
+                jnp.where(is_last, mask.sum(), 0.0), (axis, batch_axis)
+            )
+            return numer / jnp.maximum(denom, 1.0)
 
+        # loss_of is the GLOBAL loss (psum-normalized inside), identical on
+        # every mesh cell; each cell's grads are partials of that one
+        # scalar, so replicated params complete with a SUM over the axes
+        # they are replicated on (stages: data only; embed: both).
         loss, grads = jax.value_and_grad(loss_of)(params)
         grads = {
-            "stages": grads["stages"],
-            "embed": jax.lax.psum(grads["embed"], axis),
+            "stages": jax.lax.psum(grads["stages"], batch_axis),
+            "embed": jax.lax.psum(grads["embed"], (axis, batch_axis)),
         }
-        grads = jax.lax.pmean(grads, batch_axis)
-        return jax.lax.pmean(loss, batch_axis), grads
+        return loss, grads
 
     param_specs = {
         "stages": PartitionSpec(axis),

@@ -46,4 +46,14 @@ __all__ = [
     "get_dataset_shard",
     "get_context",
     "report",
+    "TransformersTrainer",
 ]
+
+
+def __getattr__(name):
+    # transformers imports are heavy; load the HF integration lazily
+    if name == "TransformersTrainer":
+        from ray_tpu.train.huggingface import TransformersTrainer
+
+        return TransformersTrainer
+    raise AttributeError(name)

@@ -135,8 +135,12 @@ def test_gpt2_pipeline_masked_batch():
                                     donate=False)
     batch = gpt2.synthetic_batch(jax.random.PRNGKey(5), 4, 32,
                                  config.vocab_size)
+    # mask counts DIFFER across data shards (rows 0-1 vs 2-3): the PP loss
+    # must be the global token-weighted mean, not a mean of per-shard
+    # masked means (which would up-weight the sparser shard)
     mask = np.ones((4, 32), np.float32)
-    mask[:, 24:] = 0.0  # padded tail
+    mask[:2, 8:] = 0.0   # shard 0: 8 valid tokens per row
+    mask[2:, 24:] = 0.0  # shard 1: 24 valid tokens per row
     batch["mask"] = jnp.asarray(mask)
     ref_loss = float(gpt2.loss_fn(ref_params, model, batch))
     _, _, loss = step(pp_params, opt_state, batch)
