@@ -1,0 +1,145 @@
+"""Core-API microbenchmarks (``ray_tpu microbenchmark``).
+
+Reference parity: ray python/ray/_private/ray_perf.py:93-311 (`ray
+microbenchmark`) — the standard suite of control-plane throughput numbers:
+task submission (sync/async), actor calls (1:1 and async), put/get of
+small objects, and put gigabytes. Values are machine-dependent; the suite
+exists so scheduler/runtime regressions show up as numbers, not vibes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+
+def _timeit(name: str, fn: Callable[[], int], warmup: int = 1,
+            repeat: int = 3) -> Tuple[str, float]:
+    """fn runs one batch and returns how many operations it performed;
+    report the best ops/s across repeats (like ray_perf's timeit)."""
+    for _ in range(warmup):
+        fn()
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    return name, best
+
+
+def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
+    """Run the suite against an initialized ray_tpu cluster. ``select``
+    substring-filters benchmark names; ``small`` shrinks batch sizes (CI)."""
+    import ray_tpu
+
+    results: List[dict] = []
+    batch = 100 if small else 1000
+    data_mb = 10 if small else 100
+
+    @ray_tpu.remote
+    def nop(*_a):
+        return b"ok"
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self, *_a):
+            return b"ok"
+
+        async def aping(self):
+            return b"ok"
+
+    def record(name, ops_s, unit="ops/s"):
+        results.append({"benchmark": name, "value": round(ops_s, 1),
+                        "unit": unit})
+        print(f"{name:<42s} {ops_s:>12,.1f} {unit}")
+
+    benches: Dict[str, Tuple[str, Callable[[], Tuple[str, float]]]] = {}
+
+    def bench(key, display):
+        def deco(fn):
+            benches[key] = (display, fn)
+            return fn
+        return deco
+
+    @bench("single_client_tasks_sync", "single client tasks sync")
+    def _tasks_sync():
+        def run():
+            for _ in range(batch // 10):
+                ray_tpu.get(nop.remote())
+            return batch // 10
+        return _timeit("single client tasks sync", run)
+
+    @bench("single_client_tasks_async", "single client tasks async")
+    def _tasks_async():
+        def run():
+            ray_tpu.get([nop.remote() for _ in range(batch)])
+            return batch
+        return _timeit("single client tasks async", run)
+
+    @bench("actor_calls_sync_1_1", "1:1 actor calls sync")
+    def _actor_sync():
+        a = Sink.remote()
+        ray_tpu.get(a.ping.remote())
+
+        def run():
+            for _ in range(batch // 10):
+                ray_tpu.get(a.ping.remote())
+            return batch // 10
+        out = _timeit("1:1 actor calls sync", run)
+        ray_tpu.kill(a)
+        return out
+
+    @bench("actor_calls_async_1_1", "1:1 actor calls async")
+    def _actor_async():
+        a = Sink.remote()
+        ray_tpu.get(a.ping.remote())
+
+        def run():
+            ray_tpu.get([a.ping.remote() for _ in range(batch)])
+            return batch
+        out = _timeit("1:1 actor calls async", run)
+        ray_tpu.kill(a)
+        return out
+
+    @bench("put_small", "small put (100B)")
+    def _put_small():
+        def run():
+            for _ in range(batch):
+                ray_tpu.put(b"x" * 100)
+            return batch
+        return _timeit("small put (100B)", run)
+
+    @bench("put_get_roundtrip", "put+get roundtrip (1KB)")
+    def _put_get():
+        def run():
+            for _ in range(batch // 10):
+                ray_tpu.get(ray_tpu.put(b"x" * 1000))
+            return batch // 10
+        return _timeit("put+get roundtrip (1KB)", run)
+
+    @bench("put_gigabytes", "put gigabytes")
+    def _put_gb():
+        arr = np.zeros(data_mb * 1024 * 1024, dtype=np.uint8)
+
+        def run():
+            ref = ray_tpu.put(arr)
+            got = ray_tpu.get(ref)
+            assert got.nbytes == arr.nbytes
+            del ref, got
+            return 2 * arr.nbytes  # bytes moved (put + get)
+        name, bps = _timeit("put gigabytes", run, warmup=1, repeat=2)
+        return name, bps / 1e9  # GB/s
+
+    for key, (display, fn) in benches.items():
+        # match either the registry key or the printed display name
+        if select and select not in key and select not in display:
+            continue
+        name, value = fn()
+        record(name, value, "GB/s" if key == "put_gigabytes" else "ops/s")
+    if not results:
+        print(f"no benchmarks matched --select {select!r}; available: "
+              + ", ".join(benches))
+    return results

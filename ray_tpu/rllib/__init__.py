@@ -1,6 +1,8 @@
 """ray_tpu.rllib — reinforcement learning (ray parity: rllib/)."""
 
 from ray_tpu.rllib.algorithm import (
+    APPO,
+    APPOConfig,
     DQN,
     DQNConfig,
     IMPALA,
@@ -19,6 +21,7 @@ from ray_tpu.rllib.algorithm import (
 from ray_tpu.rllib.env import CartPole, Reacher1D, make_env, register_env
 from ray_tpu.rllib.env_runner import ContinuousEnvRunner, EnvRunner
 from ray_tpu.rllib.learner import (
+    APPOLearner,
     DQNLearner,
     ImpalaLearner,
     Learner,
@@ -39,6 +42,9 @@ from ray_tpu.rllib.offline import BC, BCConfig, BCLearner, read_json, write_json
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
     "ContinuousEnvRunner",
     "ContinuousRLModule",
     "DDPG",

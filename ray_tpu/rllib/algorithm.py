@@ -21,6 +21,7 @@ from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.env import env_spaces, make_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import (
+    APPOLearner,
     DQNLearner,
     ImpalaLearner,
     Learner,
@@ -282,6 +283,27 @@ class IMPALA(Algorithm):
         return metrics
 
 
+class APPO(Algorithm):
+    """Async PPO (ray parity: rllib/algorithms/appo): IMPALA's fragment
+    flow, but v-trace feeds a clipped surrogate so each fragment batch
+    sustains several SGD passes."""
+
+    _learner_cls = APPOLearner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        fragments = self._sample_all()
+        for frag in fragments:
+            self._timesteps += frag.count
+        metrics = {}
+        passes = max(1, cfg.num_epochs // 2)
+        for _ in range(passes):
+            for frag in fragments:  # per-fragment: v-trace needs time order
+                metrics = self.learner.update(frag)
+        return metrics
+
+
 class DQN(Algorithm):
     _learner_cls = DQNLearner
 
@@ -423,6 +445,12 @@ class DDPG(TD3):
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(PPO)
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(APPO)
+        self.entropy_coeff = 0.01
 
 
 class IMPALAConfig(AlgorithmConfig):

@@ -80,6 +80,32 @@ class Learner:
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         raise NotImplementedError
 
+    # -- shared machinery for actor-critic learners ---------------------
+    def _build_train_step(self, loss_fn):
+        """jit the standard (loss, aux) -> optimizer step; aux must be the
+        (pi_loss, vf_loss, entropy) triple."""
+
+        def train_step(params, opt_state, mb):
+            (total, (pi, vf, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, mb)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "policy_loss": pi,
+                "vf_loss": vf, "entropy": ent,
+            }
+
+        return jax.jit(train_step)
+
+    def _update_full_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        """One jitted step over the whole (time-ordered) batch."""
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.module.params, self.opt_state, metrics = self._train_step(
+            self.module.params, self.opt_state, jmb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
 
 class PPOLearner(Learner):
     def __init__(self, module: RLModule, config):
@@ -108,18 +134,7 @@ class PPOLearner(Learner):
             total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, (pi_loss, vf_loss, entropy)
 
-        def train_step(params, opt_state, mb):
-            (total, (pi, vf, ent)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, mb)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, {
-                "total_loss": total, "policy_loss": pi,
-                "vf_loss": vf, "entropy": ent,
-            }
-
-        self._train_step = jax.jit(train_step)
+        self._train_step = self._build_train_step(loss_fn)
         self._rng = np.random.default_rng(0)
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
@@ -161,6 +176,21 @@ def vtrace(behavior_logp, target_logp, rewards, values, next_values, dones,
     return vs, pg_adv
 
 
+def _vtrace_forward(net, gamma, params, mb):
+    """Shared IMPALA/APPO forward: policy logp + v-trace targets."""
+    logits, values = net.apply({"params": params}, mb[sb.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, mb[sb.ACTIONS][:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    vs, pg_adv = vtrace(
+        mb[sb.LOGP], jax.lax.stop_gradient(target_logp),
+        mb[sb.REWARDS], jax.lax.stop_gradient(values),
+        mb[sb.VF_NEXT], mb[sb.DONES], mb[sb.TRUNCATEDS], gamma,
+    )
+    return logp_all, target_logp, values, vs, pg_adv
+
+
 class ImpalaLearner(Learner):
     def __init__(self, module: RLModule, config):
         super().__init__(module, config)
@@ -170,15 +200,8 @@ class ImpalaLearner(Learner):
         gamma = config.gamma
 
         def loss_fn(params, mb):
-            logits, values = net.apply({"params": params}, mb[sb.OBS])
-            logp_all = jax.nn.log_softmax(logits)
-            target_logp = jnp.take_along_axis(
-                logp_all, mb[sb.ACTIONS][:, None].astype(jnp.int32), axis=1
-            )[:, 0]
-            vs, pg_adv = vtrace(
-                mb[sb.LOGP], jax.lax.stop_gradient(target_logp),
-                mb[sb.REWARDS], jax.lax.stop_gradient(values),
-                mb[sb.VF_NEXT], mb[sb.DONES], mb[sb.TRUNCATEDS], gamma,
+            logp_all, target_logp, values, vs, pg_adv = _vtrace_forward(
+                net, gamma, params, mb
             )
             pi_loss = -(jax.lax.stop_gradient(pg_adv) * target_logp).mean()
             vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
@@ -186,25 +209,46 @@ class ImpalaLearner(Learner):
             total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, (pi_loss, vf_loss, entropy)
 
-        def train_step(params, opt_state, mb):
-            (total, (pi, vf, ent)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, mb)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, {
-                "total_loss": total, "policy_loss": pi,
-                "vf_loss": vf, "entropy": ent,
-            }
-
-        self._train_step = jax.jit(train_step)
+        self._train_step = self._build_train_step(loss_fn)
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
-        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
-        self.module.params, self.opt_state, metrics = self._train_step(
-            self.module.params, self.opt_state, jmb
-        )
-        return {k: float(v) for k, v in metrics.items()}
+        return self._update_full_batch(batch)
+
+
+class APPOLearner(Learner):
+    """APPO: PPO's clipped surrogate on v-trace-corrected advantages
+    (ray parity: rllib/algorithms/appo — IMPALA's off-policy correction
+    with PPO's trust region, so stale fragments can be re-used for
+    multiple SGD passes without policy collapse)."""
+
+    def __init__(self, module: RLModule, config):
+        super().__init__(module, config)
+        net = module.net
+        clip = config.clip_param
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+        gamma = config.gamma
+
+        def loss_fn(params, mb):
+            logp_all, target_logp, values, vs, pg_adv = _vtrace_forward(
+                net, gamma, params, mb
+            )
+            adv = jax.lax.stop_gradient(pg_adv)
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            ratio = jnp.exp(target_logp - mb[sb.LOGP])
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+            )
+            pi_loss = -surrogate.mean()
+            vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, (pi_loss, vf_loss, entropy)
+
+        self._train_step = self._build_train_step(loss_fn)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self._update_full_batch(batch)
 
 
 class DQNLearner(Learner):

@@ -260,6 +260,26 @@ def cmd_serve_status(args):
         print(f"{app}: {info}")
 
 
+def cmd_microbenchmark(args):
+    import ray_tpu
+    from ray_tpu._private.perf import run_microbenchmarks
+
+    addr = None
+    try:
+        addr = _resolve_address(args)
+    except SystemExit:
+        pass  # no running cluster: benchmark a fresh local one
+    if addr:
+        ray_tpu.init(address=addr)
+    else:
+        ray_tpu.init(num_cpus=4)
+    try:
+        run_microbenchmarks(select=args.select, small=args.small)
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI"
@@ -325,6 +345,15 @@ def main(argv=None):
     p = sub.add_parser("summary", help="task summary by name")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser(
+        "microbenchmark",
+        help="core-API throughput suite (ray parity: ray microbenchmark)",
+    )
+    p.add_argument("--select", default="", help="substring filter")
+    p.add_argument("--small", action="store_true", help="CI-sized batches")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_microbenchmark)
 
     p = sub.add_parser("serve", help="declarative Serve deploy/status")
     ssub = p.add_subparsers(dest="serve_command", required=True)

@@ -1,0 +1,12 @@
+"""Microbenchmark suite smoke (ray parity: ray microbenchmark /
+_private/ray_perf.py) — runs a filtered subset against the test cluster."""
+
+
+def test_microbenchmark_subset(ray_start_regular):
+    from ray_tpu._private.perf import run_microbenchmarks
+
+    results = run_microbenchmarks(select="put", small=True)
+    names = {r["benchmark"] for r in results}
+    assert "small put (100B)" in names
+    assert "put gigabytes" in names
+    assert all(r["value"] > 0 for r in results), results

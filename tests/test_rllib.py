@@ -302,3 +302,23 @@ def test_bc_clones_expert(ray_start_regular, tmp_path):
     # random CartPole policy scores ~20; a clone of a trained expert
     # should be clearly better
     assert score > 50, score
+
+
+def test_appo_learns_cartpole(ray_start_regular):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment("CartPole-native")
+        .env_runners(num_env_runners=2, rollout_fragment_length=256)
+        .debugging(seed=0)
+        .build()
+    )
+    best = 0.0
+    for _ in range(25):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean", 0.0))
+        if best >= 120:
+            break
+    algo.stop()
+    assert best >= 100, f"APPO failed to learn CartPole (best={best})"
