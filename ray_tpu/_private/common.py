@@ -114,6 +114,11 @@ class TaskSpec:
     # Propagated tracing context {trace_id, span_id} (ray:
     # tracing_helper.py:105-226 injects span context into task calls).
     tracing_ctx: Optional[dict] = None
+    # Node that last spilled this task to its current location; that node
+    # tracks the task and resubmits it if the executing node dies
+    # (plays the reference's owner-side lease-failure retry role for the
+    # fire-and-forget spillback flow).
+    origin_node: Optional[str] = None
 
     def scheduling_class(self) -> tuple:
         return (tuple(sorted(self.resources.items())), self.name)

@@ -194,6 +194,16 @@ class Raylet:
             int(cfg.object_store_memory * cfg.pull_manager_memory_fraction),
         )
         self._rr = [0]
+        # tasks we spilled elsewhere and must resubmit if that node dies:
+        # target_node_id -> {task_id: spec}
+        self._spilled_away: Dict[str, Dict[bytes, TaskSpec]] = {}
+        # spill_done notices that raced ahead of our own bookkeeping (a
+        # chained re-spill can settle before our spill_submit await
+        # resumes); matched and removed in _schedule_or_queue
+        self._spill_released: set = set()
+        # strong refs to fire-and-forget loop tasks (the event loop holds
+        # tasks weakly; a GC'd pending task would silently drop its work)
+        self._bg_tasks: set = set()
         self._tasks: List[asyncio.Task] = []
         self._dispatch_event = asyncio.Event()
         self._stopping = False
@@ -452,7 +462,9 @@ class Raylet:
         self._on_view(view)
 
     def _on_view(self, view):
+        died = []
         for n in view:
+            prev = self.cluster_view.get(n["node_id"])
             info = NodeInfo(
                 node_id=n["node_id"], host=n["host"], port=n["port"],
                 store_dir=n["store_dir"], resources_total=n["resources_total"],
@@ -461,12 +473,35 @@ class Raylet:
             info.resources_available = n["resources_available"]
             info.alive = n["alive"]
             self.cluster_view[n["node_id"]] = info
+            if prev is not None and prev.alive and not info.alive:
+                died.append(n["node_id"])
         # Keep our own availability authoritative locally.
         me = self.cluster_view.get(self.node_id)
         if me:
             me.resources_available = self.resources_available
             me.resources_total = self.resources_total
+        for node_id in died:
+            self._resubmit_spilled_to(node_id)
         self._dispatch_event.set()
+
+    def _resubmit_spilled_to(self, node_id: str):
+        """A node we spilled tasks to died before reporting them settled:
+        schedule them again from here (at-least-once for tasks caught
+        mid-flight by a node failure — the reference re-executes such tasks
+        through the owner's lease-failure retry path)."""
+        stranded = self._spilled_away.pop(node_id, None)
+        if not stranded:
+            return
+        logger.warning(
+            "node %s died with %d task(s) we spilled there; resubmitting",
+            node_id[:12], len(stranded),
+        )
+        loop = asyncio.get_running_loop()
+        for spec in stranded.values():
+            spec.origin_node = None
+            t = loop.create_task(self._schedule_or_queue(spec))
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
 
     async def _peer(self, node_id: str) -> Optional[Connection]:
         conn = self.peers.get(node_id)
@@ -622,6 +657,34 @@ class Raylet:
         await self._schedule_or_queue(p["spec"], depth=p.get("depth", 0))
         return {}
 
+    def rpc_spill_done(self, conn: Connection, p):
+        """The node we spilled a task to reports it finished (or moved on):
+        drop our resubmission liability."""
+        key = (p["node_id"], p["task_id"])
+        tracked = self._spilled_away.get(p["node_id"])
+        if tracked and tracked.pop(p["task_id"], None) is not None:
+            return
+        # raced ahead of our own spill bookkeeping (chained re-spill can
+        # settle before our spill_submit await resumes): tombstone it
+        self._spill_released.add(key)
+        if len(self._spill_released) > 10_000:  # bound pathological leaks
+            self._spill_released.pop()
+
+    async def _notify_spill_origin(self, spec: TaskSpec):
+        """Tell the tracking node this task's fate is settled here."""
+        origin = getattr(spec, "origin_node", None)
+        if not origin or origin == self.node_id or spec.actor_id:
+            return
+        peer = await self._peer(origin)
+        if peer is not None:
+            try:
+                await peer.notify(
+                    "spill_done",
+                    {"node_id": self.node_id, "task_id": spec.task_id},
+                )
+            except Exception:
+                pass
+
     async def _schedule_or_queue(self, spec: TaskSpec, depth: int = 0):
         demand = spec.resources
         nodes = list(self.cluster_view.values())
@@ -633,12 +696,37 @@ class Raylet:
         if target != self.node_id and depth < cfg.max_spillback_depth:
             peer = await self._peer(target)
             if peer is not None:
+                prev_origin = getattr(spec, "origin_node", None)
+                spec.origin_node = self.node_id
                 try:
                     await peer.request("spill_submit", {"spec": spec, "depth": depth + 1})
                     self.counters["tasks_spilled"] += 1
+                    # We now carry the resubmission liability for this task
+                    # (normal tasks only: actor restarts are GCS-driven);
+                    # the previous tracker is off the hook.
+                    if not spec.actor_id:
+                        key = (target, spec.task_id)
+                        if key in self._spill_released:
+                            # its fate settled before our await resumed
+                            self._spill_released.discard(key)
+                        else:
+                            self._spilled_away.setdefault(target, {})[
+                                spec.task_id
+                            ] = spec
+                        if prev_origin and prev_origin != self.node_id:
+                            prev = await self._peer(prev_origin)
+                            if prev is not None:
+                                try:
+                                    await prev.notify(
+                                        "spill_done",
+                                        {"node_id": self.node_id,
+                                         "task_id": spec.task_id},
+                                    )
+                                except Exception:
+                                    pass
                     return
                 except Exception:
-                    pass
+                    spec.origin_node = prev_origin
         self._queue_local(spec)
 
     def _queue_local(self, spec: TaskSpec):
@@ -800,6 +888,7 @@ class Raylet:
             "returns_nested": result.get("returns_nested"),
         }
         await self._route_to_owner(spec.owner, "task_result", payload)
+        await self._notify_spill_origin(spec)
 
     async def _route_to_owner(self, owner: tuple, method: str, payload):
         node_id, client_id = owner
@@ -838,6 +927,7 @@ class Raylet:
              "system_error": True, "retriable": retriable, "attempt": spec.attempt,
              "lost_object": lost_object},
         )
+        await self._notify_spill_origin(spec)
 
     # ------------------------------------------------------------------
     # worker pool
@@ -1308,6 +1398,9 @@ class Raylet:
                 {"task_id": tid, "results": None, "error": "task cancelled",
                  "cancelled": True, "retriable": False, "attempt": qt.spec.attempt},
             )
+            # release the spiller's resubmission liability, or a later
+            # node death would resurrect the cancelled task
+            await self._notify_spill_origin(qt.spec)
             return {"cancelled": True}
         running = self.running.get(tid)
         if running is not None and p.get("force") and running.worker is not None:

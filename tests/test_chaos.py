@@ -1,0 +1,76 @@
+"""Node-death chaos: kill a raylet mid-workload, the job survives.
+
+Reference parity: ray python/ray/tests/test_chaos.py + NodeKillerActor
+(_private/test_utils.py:1400 kills raylets, graceful or not) — here the
+Cluster fixture's remove_node(graceful=False) is the killer.
+"""
+
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote(max_retries=4)
+def slow_echo(x, delay=0.2):
+    time.sleep(delay)
+    return x
+
+
+def test_node_death_tasks_retry_elsewhere(ray_start_cluster):
+    """Tasks in flight on a killed node are retried on survivors."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)  # head
+    node_b = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    refs = [slow_echo.options(scheduling_strategy="SPREAD").remote(i)
+            for i in range(16)]
+    time.sleep(0.4)  # let some tasks land on node B
+    cluster.remove_node(node_b, graceful=False)
+    got = ray_tpu.get(refs, timeout=120)
+    assert got == list(range(16))
+
+
+def test_node_death_actor_restarts_elsewhere(ray_start_cluster):
+    """A restartable actor on a killed node comes back on another node and
+    serves calls again (max_restarts + max_task_retries)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)  # head: no "spot" resource
+    node_b = cluster.add_node(num_cpus=2, resources={"spot": 1.0})
+    node_c = cluster.add_node(num_cpus=2, resources={"spot": 1.0})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=4, num_cpus=1,
+                    resources={"spot": 1})
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+    home = ray_tpu.get(c.where.remote(), timeout=60)
+    victim = node_b if home == node_b.node_id else node_c
+    assert home == victim.node_id
+    cluster.remove_node(victim, graceful=False)
+
+    # calls retry while the GCS restarts the actor on the surviving
+    # spot-capable node; state is fresh (restart, not migration)
+    deadline = time.monotonic() + 90
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = ray_tpu.get(c.bump.remote(), timeout=30)
+            break
+        except Exception:
+            time.sleep(1.0)
+    assert value is not None and value >= 1, value
+    new_home = ray_tpu.get(c.where.remote(), timeout=30)
+    assert new_home != victim.node_id
