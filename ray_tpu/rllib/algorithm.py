@@ -162,22 +162,22 @@ class Algorithm(Trainable):
             num_cpus=0.5,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
         )(EnvRunner)
+        self._runner_factory = lambda i, replacement=False: runner_cls.remote(
+            cfg.env, cfg.env_config,
+            {"hiddens": tuple(cfg.model.get("hiddens", (64, 64)))},
+            seed=cfg.seed + i,
+        )
         self.runners = [
-            runner_cls.remote(
-                cfg.env, cfg.env_config,
-                {"hiddens": tuple(cfg.model.get("hiddens", (64, 64)))},
-                seed=cfg.seed + i,
-            )
-            for i in range(cfg.num_env_runners)
+            self._runner_factory(i) for i in range(cfg.num_env_runners)
         ]
         self._timesteps = 0
 
     def step(self) -> Dict:
         metrics = self.training_step()
         metrics["num_env_steps_sampled_lifetime"] = self._timesteps
-        runner_metrics = ray_tpu.get(
+        runner_metrics = self._with_runner_ft(lambda: ray_tpu.get(
             [r.get_metrics.remote() for r in self.runners]
-        )
+        ))
         returns = [
             m["episode_return_mean"]
             for m in runner_metrics
@@ -193,18 +193,76 @@ class Algorithm(Trainable):
         raise NotImplementedError
 
     # -- utils ----------------------------------------------------------
+    def _restore_dead_runners(self):
+        """Probe each runner and replace the dead (ray parity:
+        rllib/utils/actor_manager.py FaultTolerantActorManager — a killed
+        rollout worker is recreated, not fatal to training)."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        probes = [r.ping.remote() for r in self.runners]
+        replaced = 0
+        weights = None
+        for i, p in enumerate(probes):
+            try:
+                ray_tpu.get(p, timeout=120)
+                continue
+            except Exception:
+                pass
+            try:
+                # a slow-but-alive runner misdiagnosed by the probe must
+                # not linger as a duplicate actor eating CPU
+                ray_tpu.kill(self.runners[i])
+            except Exception:
+                pass
+            self.runners[i] = self._runner_factory(i, replacement=True)
+            replaced += 1
+            # fresh runner must not sample with init weights: retry the
+            # push once, and if it still fails say so loudly — on-policy
+            # learners would train on a stale-policy fragment otherwise
+            if weights is None:
+                weights = ray_tpu.put(self.learner.get_weights())
+            for attempt in (1, 2):
+                try:
+                    ray_tpu.get(
+                        self.runners[i].set_weights.remote(weights),
+                        timeout=120,
+                    )
+                    break
+                except Exception as e:
+                    if attempt == 2:
+                        log.warning(
+                            "replacement runner %d did not take weights "
+                            "(%s); its first fragment may be off-policy",
+                            i, e,
+                        )
+        if replaced:
+            log.warning("replaced %d dead env runner(s)", replaced)
+        return replaced
+
+    def _with_runner_ft(self, fn):
+        """Run a fan-out once; on failure restore dead runners and retry."""
+        try:
+            return fn()
+        except Exception:
+            if not self._restore_dead_runners():
+                raise
+            return fn()
+
     def _sync_weights(self):
         weights = ray_tpu.put(self.learner.get_weights())
-        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+        self._with_runner_ft(lambda: ray_tpu.get(
+            [r.set_weights.remote(weights) for r in self.runners]
+        ))
 
     def _sample_all(self) -> List[SampleBatch]:
         cfg = self.config
-        return ray_tpu.get(
+        return self._with_runner_ft(lambda: ray_tpu.get(
             [
                 r.sample.remote(cfg.rollout_fragment_length)
                 for r in self.runners
             ]
-        )
+        ))
 
     def compute_single_action(self, obs, explore: bool = False):
         obs = np.asarray(obs, np.float32)[None, :]
@@ -398,14 +456,17 @@ class TD3(Algorithm):
             num_cpus=0.5,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
         )(ContinuousEnvRunner)
+        # a REPLACEMENT runner mid-training must not redo its uniform-
+        # random warmup: it gets the current (trained) weights pushed and
+        # should explore around them immediately
+        self._runner_factory = lambda i, replacement=False: runner_cls.remote(
+            cfg.env, cfg.env_config, {"hiddens": hiddens},
+            seed=cfg.seed + i,
+            noise_scale=getattr(cfg, "exploration_noise", 0.1),
+            warmup_steps=0 if replacement else getattr(cfg, "warmup_steps", 500),
+        )
         self.runners = [
-            runner_cls.remote(
-                cfg.env, cfg.env_config, {"hiddens": hiddens},
-                seed=cfg.seed + i,
-                noise_scale=getattr(cfg, "exploration_noise", 0.1),
-                warmup_steps=getattr(cfg, "warmup_steps", 500),
-            )
-            for i in range(cfg.num_env_runners)
+            self._runner_factory(i) for i in range(cfg.num_env_runners)
         ]
         self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
         self._timesteps = 0

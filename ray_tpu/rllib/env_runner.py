@@ -46,6 +46,11 @@ class _RunnerBase:
     def get_weights(self):
         return self.module.get_state()
 
+    def ping(self):
+        """Non-destructive liveness probe (get_metrics drains episode
+        stats, so health checks must not use it)."""
+        return True
+
     def _end_step(self, reward, terminated, truncated, nxt):
         """Advance episode accounting after one env step; returns True if
         an episode boundary was crossed (env already reset)."""

@@ -322,3 +322,24 @@ def test_appo_learns_cartpole(ray_start_regular):
             break
     algo.stop()
     assert best >= 100, f"APPO failed to learn CartPole (best={best})"
+
+
+def test_runner_death_recovers(ray_start_regular):
+    """Killing an env-runner actor mid-training is absorbed: the algorithm
+    replaces it and keeps training (ray parity: FaultTolerantActorManager,
+    rllib/utils/actor_manager.py:189)."""
+    algo = (
+        PPOConfig()
+        .environment("CartPole-native")
+        .env_runners(num_env_runners=2, rollout_fragment_length=64)
+        .training(num_epochs=2)
+        .debugging(seed=0)
+        .build()
+    )
+    algo.train()
+    victim = algo.runners[0]
+    ray_tpu.kill(victim)
+    result = algo.train()  # must not raise; runner gets replaced
+    assert result["num_env_steps_sampled_lifetime"] >= 2 * 2 * 64
+    assert algo.runners[0] is not victim
+    algo.stop()
