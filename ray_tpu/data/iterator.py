@@ -92,6 +92,65 @@ class DataIterator:
             shuffle_seed=local_shuffle_seed,
         )
 
+    def _iter_mapped_batches(self, convert, *, batch_size, **kwargs):
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kwargs):
+            if isinstance(batch, dict):
+                yield {k: convert(k, v) for k, v in batch.items()}
+            else:
+                yield convert(None, batch)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: Optional[str] = None,
+                           **kwargs) -> Iterator[Any]:
+        """Batches as torch tensors (ray parity: iter_torch_batches) —
+        dict of tensors for tabular data, a single tensor for simple
+        blocks. ``dtypes``: torch dtype or {column: dtype}."""
+        import numpy as np
+        import torch
+
+        def convert(col, arr):
+            arr = np.asarray(arr)
+            if not arr.flags.writeable:
+                # zero-copy Arrow view: a tensor sharing it would make
+                # in-place train-loop ops corrupt the block store
+                arr = arr.copy()
+            t = torch.as_tensor(arr)
+            want = dtypes.get(col) if isinstance(dtypes, dict) else dtypes
+            if want is not None:
+                t = t.to(want)
+            if device:
+                t = t.to(device)
+            return t
+
+        return self._iter_mapped_batches(convert, batch_size=batch_size,
+                                         **kwargs)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, **kwargs) -> Iterator[Any]:
+        """Batches as jax arrays, optionally placed with a Sharding —
+        the TPU-native analog of iter_torch_batches: pass the mesh's data
+        sharding so host->device transfer lands batches already laid out
+        for the pjit step (no per-step device_put in the train loop).
+
+        With a sharding, ``drop_last`` defaults to True: a partial final
+        batch cannot be laid out over a fixed device axis (device_put
+        would fail on the non-divisible batch dim). Pass drop_last=False
+        explicitly only with shardings that admit ragged batch sizes.
+        """
+        import jax
+
+        if sharding is not None:
+            kwargs.setdefault("drop_last", True)
+
+        def place(_col, arr):
+            if sharding is not None:
+                return jax.device_put(arr, sharding)
+            return jax.numpy.asarray(arr)
+
+        return self._iter_mapped_batches(place, batch_size=batch_size,
+                                         **kwargs)
+
     def iter_rows(self) -> Iterator[Any]:
         import ray_tpu
 

@@ -286,3 +286,44 @@ def test_streaming_split_abandoned_epoch(ray_start_regular):
     for b in its[0].iter_batches(batch_size=None):  # epoch 2
         rows.extend(np.asarray(b).tolist())
     assert sorted(rows) == list(range(12)), rows
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.from_items([{"x": float(i), "y": i} for i in range(10)])
+    batches = list(ds.iterator().iter_torch_batches(
+        batch_size=4, dtypes={"x": torch.float32}
+    ))
+    assert len(batches) == 3
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].dtype == torch.float32
+    total = sum(int(b["y"].sum()) for b in batches)
+    assert total == sum(range(10))
+
+
+def test_iter_jax_batches_with_sharding(ray_start_regular):
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ray_tpu import data, parallel
+
+    mesh = parallel.create_mesh({"data": 8})
+    sh = NamedSharding(mesh, PartitionSpec("data"))
+    ds = data.from_items([{"x": float(i)} for i in range(16)])
+    batches = list(ds.iterator().iter_jax_batches(batch_size=8, sharding=sh))
+    assert len(batches) == 2
+    b = batches[0]["x"]
+    assert isinstance(b, jax.Array) and b.sharding == sh
+    total = sum(float(np.asarray(jax.device_get(bt["x"])).sum())
+                for bt in batches)
+    assert total == float(sum(range(16)))
+
+    # partial final batch: with a sharding, drop_last defaults True so the
+    # non-divisible remainder is dropped instead of crashing device_put
+    ds10 = data.from_items([{"x": float(i)} for i in range(10)])
+    b10 = list(ds10.iterator().iter_jax_batches(batch_size=8, sharding=sh))
+    assert len(b10) == 1 and b10[0]["x"].shape == (8,)
