@@ -16,6 +16,7 @@ import asyncio
 import concurrent.futures
 import inspect
 import logging
+import os
 import threading
 import time
 import traceback
@@ -256,6 +257,8 @@ class TaskExecutor:
 
     # ------------------------------------------------------------------
     def _package_returns(self, spec: TaskSpec, value: Any, start: float):
+        if spec.num_returns == -1:  # num_returns="dynamic"
+            return self._package_dynamic_returns(spec, value, start)
         values = (value,) if spec.num_returns == 1 else tuple(value)
         if spec.num_returns > 1 and len(values) != spec.num_returns:
             sv = serialization.serialize_error(
@@ -307,6 +310,71 @@ class TaskExecutor:
             # Borrower-protocol report (ray: PushTaskReply.borrowed_refs):
             # borrows this worker still holds (e.g. refs stashed in actor
             # state) so the owner can register us before releasing arg pins.
+            "exec_addr": self.cw.addr,
+            "borrows_kept": self.cw.borrowed_refs_held(),
+            "returns_nested": returns_nested or None,
+        }
+
+    def _package_dynamic_returns(self, spec: TaskSpec, value: Any,
+                                 start: float):
+        """num_returns="dynamic" (ray: task_manager.h ObjectRefStream /
+        legacy dynamic generators): the task returns an iterable of unknown
+        length; each yielded item is stored as its own object (return index
+        2, 3, ... — index 1 is the ref-list itself) and the single visible
+        return resolves to the list of ObjectRefs. The caller adopts
+        ownership of the item objects from the result notification
+        (dynamic_return_oids), so lineage reconstruction re-executes this
+        task if an item's plasma copy is lost."""
+        from ray_tpu._private.object_ref import ObjectRef
+
+        tid = TaskID(spec.task_id)
+        item_oids = []
+        returns_nested = {}
+        return_pins = []
+        try:
+            for i, item in enumerate(value):
+                sv = serialization.serialize(item)
+                oid = ObjectID.from_index(tid, i + 2)
+                object_store.write_object(
+                    self.cw.store_dir, oid, sv.metadata, sv.buffers,
+                    sv.total_data_len,
+                )
+                item_oids.append(oid.binary())
+                if sv.nested_refs:
+                    # refs escaping inside a yielded value: same handoff as
+                    # plain returns — pinned here until the caller registers
+                    # as borrower and acks (keyed so the caller's
+                    # from_index(key+1) lands on THIS item, index i+2)
+                    returns_nested[i + 1] = list(sv.nested_refs)
+                    for oid_b, owner in sv.nested_refs:
+                        return_pins.append(self.cw.pin_object(oid_b, owner))
+        except Exception as e:
+            # a partial run must not orphan the items already written
+            for oid_b in item_oids:
+                try:
+                    os.unlink(object_store._obj_path(
+                        self.cw.store_dir, ObjectID(oid_b)
+                    ))
+                except OSError:
+                    pass
+            for t in return_pins:
+                self.cw.unpin_object(t)
+            esv = serialization.serialize_error(e, spec.name)
+            return self._error_result(esv, app_error=True)
+        refs = [
+            ObjectRef(ObjectID(oid), tuple(spec.owner)) for oid in item_oids
+        ]
+        sv = serialization.serialize(refs)
+        results = [("v", sv.metadata, sv.to_bytes())]
+        if return_pins:
+            with self.cw._lock:
+                self.cw._return_pins[spec.task_id] = return_pins
+            self.cw.io.call_soon(self._expire_return_pins(spec.task_id))
+        return {
+            "results": results,
+            "stored_objects": list(item_oids),
+            "dynamic_return_oids": list(item_oids),
+            "duration": time.time() - start,
             "exec_addr": self.cw.addr,
             "borrows_kept": self.cw.borrowed_refs_held(),
             "returns_nested": returns_nested or None,

@@ -886,6 +886,8 @@ class Raylet:
             "exec_addr": result.get("exec_addr"),
             "borrows_kept": result.get("borrows_kept"),
             "returns_nested": result.get("returns_nested"),
+            # num_returns="dynamic": item objects the owner must adopt
+            "dynamic_return_oids": result.get("dynamic_return_oids"),
         }
         await self._route_to_owner(spec.owner, "task_result", payload)
         await self._notify_spill_origin(spec)

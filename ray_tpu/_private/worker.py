@@ -263,10 +263,26 @@ class CoreWorker:
         tid = TaskID(spec.task_id)
         with self._lock:
             self._specs_inflight.pop(spec.task_id, None)
-        for i in range(spec.num_returns):
+        for i in range(max(1, spec.num_returns)):
             oid = ObjectID.from_index(tid, i + 1)
             self._resolve_inline(oid.binary(), sv.metadata, sv.to_bytes())
+        self._fail_dynamic_item_futures(spec, sv)
         self._release_task_pins(spec.task_id)
+
+    def _fail_dynamic_item_futures(self, spec: Optional[TaskSpec], sv):
+        """A failed dynamic task must also resolve any ITEM futures parked
+        by reconstruction (their indices aren't enumerable from
+        num_returns): sweep pending futures keyed by this task's prefix."""
+        if spec is None or spec.num_returns != -1:
+            return
+        prefix = spec.task_id
+        with self._lock:
+            pending = [
+                oid for oid, f in self._futures.items()
+                if oid.startswith(prefix) and not f.done()
+            ]
+        for oid in pending:
+            self._resolve_inline(oid, sv.metadata, sv.to_bytes())
 
     # ------------------------------------------------------------------
     # submission
@@ -321,9 +337,12 @@ class CoreWorker:
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
         task_id = TaskID(spec.task_id)
+        # dynamic (-1): one visible return — the ref-list; item objects are
+        # adopted at result time (rpc_task_result dynamic_return_oids)
+        n = 1 if spec.num_returns == -1 else spec.num_returns
         with self._lock:
             self._specs_inflight[spec.task_id] = spec
-            for i in range(spec.num_returns):
+            for i in range(n):
                 oid = ObjectID.from_index(task_id, i + 1)
                 fut = concurrent.futures.Future()
                 self._futures[oid.binary()] = fut
@@ -518,6 +537,26 @@ class CoreWorker:
         with self._lock:
             self._specs_inflight.pop(task_id, None)
         tid = TaskID(task_id)
+        # num_returns="dynamic": adopt ownership of the item objects BEFORE
+        # the ref-list materializes (deserializing it registers refs, which
+        # must find their oids in _owned), record their lineage so a lost
+        # item re-executes this task, and pin each under the ref-list
+        # container so dropping the (possibly never-materialized) list
+        # frees the items (_maybe_free releases _contains pins).
+        dyn_oids = p.get("dynamic_return_oids") or ()
+        if dyn_oids:
+            list_oid = ObjectID.from_index(tid, 1).binary()
+            tokens = []
+            for oid in dyn_oids:
+                with self._lock:
+                    self._owned.add(oid)
+                    if spec is not None:
+                        self._lineage_insert_locked(oid, spec)
+                tokens.append(self.pin_object(oid, self.addr))
+                # a reconstruction (or wait) may be parked on this item
+                self._resolve_plasma(oid)
+            with self._lock:
+                self._contains.setdefault(list_oid, []).extend(tokens)
         for i, res in enumerate(results):
             oid = ObjectID.from_index(tid, i + 1)
             if res[0] == "v":
@@ -585,17 +624,23 @@ class CoreWorker:
         except Exception:
             return {"owner_dead": True}
 
+    def _lineage_insert_locked(self, oid: bytes, spec: TaskSpec):
+        """Insert under self._lock, enforcing the FIFO cap."""
+        self._lineage[oid] = spec
+        overflow = len(self._lineage) - cfg.max_lineage_cache_entries
+        if overflow > 0:
+            for old in list(self._lineage)[:overflow]:
+                del self._lineage[old]
+
     def _record_lineage(self, spec: TaskSpec):
         """Remember the finalized spec so lost plasma returns can be
         re-executed (ray: task_manager.h lineage pinning, FIFO-capped)."""
         tid = TaskID(spec.task_id)
         with self._lock:
-            for i in range(spec.num_returns):
-                self._lineage[ObjectID.from_index(tid, i + 1).binary()] = spec
-            overflow = len(self._lineage) - cfg.max_lineage_cache_entries
-            if overflow > 0:
-                for oid in list(self._lineage)[:overflow]:
-                    del self._lineage[oid]
+            for i in range(max(1, spec.num_returns)):
+                self._lineage_insert_locked(
+                    ObjectID.from_index(tid, i + 1).binary(), spec
+                )
 
     async def _handle_task_error(self, spec: Optional[TaskSpec], task_id: bytes, p):
         retriable = p.get("retriable", False)
@@ -629,7 +674,7 @@ class CoreWorker:
         with self._lock:
             self._specs_inflight.pop(task_id, None)
         tid = TaskID(task_id)
-        n_returns = spec.num_returns if spec else 1
+        n_returns = max(1, spec.num_returns) if spec else 1
         if p.get("error_value"):
             meta, data = p["error_value"]
         else:
@@ -644,6 +689,17 @@ class CoreWorker:
         for i in range(n_returns):
             oid = ObjectID.from_index(tid, i + 1)
             self._resolve_inline(oid.binary(), meta, data)
+        if spec is not None and spec.num_returns == -1:
+            # item futures parked by a dynamic reconstruction must see the
+            # terminal error too, or gets on them hang forever
+            prefix = spec.task_id
+            with self._lock:
+                pending = [
+                    o for o, f in self._futures.items()
+                    if o.startswith(prefix) and not f.done()
+                ]
+            for o in pending:
+                self._resolve_inline(o, meta, data)
         if spec is not None:
             # A failed task may still have stashed arg refs (actor state):
             # register those borrows before dropping our arg pins.
@@ -810,9 +866,50 @@ class CoreWorker:
             if not fut.done():
                 fut.set_result(("plasma", None, None))
             return fut
+        if ref.binary() in self._owned or (
+            ref.owner is not None and tuple(ref.owner) == self.addr
+        ):
+            # Owned but not local (e.g. a dynamic return stored on the
+            # executing node, or a lost copy): pull, else reconstruct.
+            self.io.call_soon(self._resolve_owned_missing(ref, fut))
+            return fut
         # Borrowed ref: resolve in background (plasma pull or owner fetch).
         self.io.call_soon(self._resolve_borrowed(ref, fut))
         return fut
+
+    async def _resolve_owned_missing(self, ref: ObjectRef,
+                                     fut: concurrent.futures.Future):
+        oid = ref.binary()
+        try:
+            ok = await self.raylet.request(
+                "pull_object", {"object_id": oid, "timeout": 10.0}
+            )
+            if ok.get("ok") and object_store.object_exists(
+                self.store_dir, ref.id()
+            ):
+                if not fut.done():
+                    fut.set_result(("plasma", None, None))
+                return
+        except Exception:
+            pass
+        try:
+            rfut = await self._reconstruct_owned(oid)
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        if rfut is fut:
+            return  # resolution arrives via the task-result path
+
+        def _copy(rf):
+            if fut.done():
+                return
+            try:
+                fut.set_result(rf.result())
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        rfut.add_done_callback(_copy)
 
     async def _resolve_borrowed(self, ref: ObjectRef, fut: concurrent.futures.Future):
         oid = ref.binary()
@@ -1281,9 +1378,14 @@ class CoreWorker:
             spec.reconstructions += 1
             spec.attempt += 1
             tid = TaskID(spec.task_id)
-            for i in range(spec.num_returns):
+            for i in range(1 if spec.num_returns == -1 else spec.num_returns):
                 roid = ObjectID.from_index(tid, i + 1).binary()
                 self._futures[roid] = concurrent.futures.Future()
+            # dynamic item oids (return index >= 2) are not enumerated by
+            # num_returns: register the requested one explicitly, replacing
+            # a stale done future (its "plasma" result predates the loss)
+            if oid not in self._futures or self._futures[oid].done():
+                self._futures[oid] = concurrent.futures.Future()
             self._specs_inflight[spec.task_id] = spec
             fut = self._futures[oid]
         logger.info("reconstructing %s via task %s (attempt %d)",

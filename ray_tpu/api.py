@@ -353,6 +353,11 @@ class RemoteFunction:
         opts = self._options
         cw = global_worker.core_worker
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "dynamic":
+            # ray parity: num_returns="dynamic" — the single visible ref
+            # resolves to a list of per-item ObjectRefs (task_manager.h
+            # ObjectRefStream / legacy dynamic generators)
+            num_returns = -1
         refs = cw.submit_task(
             self._function,
             args=args,
@@ -366,7 +371,7 @@ class RemoteFunction:
             func_blob=self._func_blob,
             runtime_env=_prepare_runtime_env(opts.get("runtime_env")),
         )
-        if num_returns == 1:
+        if num_returns in (1, -1):  # -1 = dynamic: one visible ref
             return refs[0]
         return refs
 
@@ -388,9 +393,12 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, **opts):
-        return ActorMethod(
-            self._handle, self._name, num_returns=opts.get("num_returns", self._num_returns)
-        )
+        num_returns = opts.get("num_returns", self._num_returns)
+        if num_returns == "dynamic":
+            raise ValueError(
+                "num_returns='dynamic' is not supported for actor tasks"
+            )
+        return ActorMethod(self._handle, self._name, num_returns=num_returns)
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(
