@@ -100,6 +100,10 @@ class TaskSpec:
     max_task_retries: int = 0
     max_concurrency: int = 1
     concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    # Which declared concurrency group this actor task runs under (None =
+    # the default group, capped by max_concurrency). ray parity:
+    # src/ray/core_worker/transport/concurrency_group_manager.h
+    concurrency_group: Optional[str] = None
     lifetime: Optional[str] = None  # None | "detached"
     name_registered: Optional[str] = None  # named actor
     namespace: Optional[str] = None

@@ -47,6 +47,11 @@ class TaskExecutor:
             max_workers=1, thread_name_prefix="task-exec"
         )
         self.max_concurrency = 1
+        # Declared concurrency groups → per-group asyncio.Semaphore
+        # ("_default" caps ungrouped methods at max_concurrency). Empty
+        # when the actor declares no groups. ray parity:
+        # src/ray/core_worker/transport/concurrency_group_manager.h
+        self._group_sems: Dict[str, asyncio.Semaphore] = {}
         self.actor_instance: Any = None
         self.actor_spec: Optional[TaskSpec] = None
         self._caller_queues: Dict[bytes, _CallerQueue] = {}
@@ -78,6 +83,20 @@ class TaskExecutor:
             cls = cloudpickle.loads(spec.func_blob)
             args, kwargs = await self._resolve_args(spec)
             self.max_concurrency = max(1, spec.max_concurrency)
+            groups = dict(spec.concurrency_groups or {})
+            if groups:
+                # Declaring groups makes the actor concurrent: each group
+                # gets its own admission semaphore, ungrouped methods share
+                # the "_default" group capped at max_concurrency, and the
+                # thread pool is sized so no group can starve another.
+                self._group_sems = {
+                    name: asyncio.Semaphore(cap) for name, cap in groups.items()
+                }
+                self._group_sems["_default"] = asyncio.Semaphore(
+                    self.max_concurrency
+                )
+                # Total threads = every group saturated at once.
+                self.max_concurrency += sum(groups.values())
             if self.max_concurrency > 1:
                 self.pool = concurrent.futures.ThreadPoolExecutor(
                     max_workers=self.max_concurrency, thread_name_prefix="actor-exec"
@@ -96,8 +115,27 @@ class TaskExecutor:
     # ------------------------------------------------------------------
     async def execute_task(self, spec: TaskSpec):
         is_actor_task = spec.actor_id is not None and not spec.actor_creation
+        sem = None
+        if is_actor_task and (self._group_sems or spec.concurrency_group):
+            group = spec.concurrency_group or "_default"
+            sem = self._group_sems.get(group)
+            if sem is None:
+                err = ValueError(
+                    f"unknown concurrency group {group!r}; this actor "
+                    f"declares {sorted(g for g in self._group_sems if g != '_default')}"
+                )
+                return self._error_result(
+                    serialization.serialize_error(err, spec.name),
+                    app_error=False,
+                )
         if is_actor_task and self.max_concurrency == 1:
             await self._await_turn(spec.caller_id, spec.seq_no)
+        if sem is not None:
+            async with sem:
+                return await self._execute_gated(spec, is_actor_task)
+        return await self._execute_gated(spec, is_actor_task)
+
+    async def _execute_gated(self, spec: TaskSpec, is_actor_task: bool):
         try:
             ctx = getattr(spec, "tracing_ctx", None)
             if ctx is not None:

@@ -66,25 +66,43 @@ def _clean(s) -> bool:
     return not (set(str(s)) & _RESERVED)
 
 
+def _on_grid(res: Dict[str, float]) -> bool:
+    """The C++ engine quantizes to 1e-4 fixed point (llround); a value off
+    that grid could make native and the Python-oracle policies pick
+    different nodes. Screen such inputs out so they take the oracle path."""
+    for v in res.values():
+        scaled = float(v) * 1e4
+        if abs(scaled - round(scaled)) > 1e-6:
+            return False
+    return True
+
+
 def encodable(nodes, demand, strategy=None,
               bundles=None) -> bool:
     """The line-oriented wire format has no escaping: any node id, resource
     name, label, or selector value containing a separator char (or an
     empty-string selector value, which the format cannot represent) must be
-    scheduled by the Python oracle instead."""
+    scheduled by the Python oracle instead; likewise values off the engine's
+    1e-4 fixed-point grid (see _on_grid)."""
     for n in nodes:
         if not _clean(n.node_id):
             return False
         for res in (n.resources_total, n.resources_available):
             if not all(_clean(k) for k in res):
                 return False
+            if not _on_grid(res):
+                return False
         for k, v in (n.labels or {}).items():
             if not (_clean(k) and _clean(v)):
                 return False
     if not all(_clean(k) for k in demand or {}):
         return False
+    if demand and not _on_grid(demand):
+        return False
     for b in bundles or []:
         if not all(_clean(k) for k in b):
+            return False
+        if not _on_grid(b):
             return False
     if strategy is not None:
         for sel in (getattr(strategy, "labels_hard", None),

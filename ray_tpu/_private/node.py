@@ -30,6 +30,40 @@ def _make_session_dir(session_root: Optional[str] = None) -> str:
     return session_dir
 
 
+def load_cluster_token(session_dir: Optional[str] = None) -> Optional[str]:
+    """Load a persisted cluster token into the environment if unset.
+
+    Tries, in order: an explicit ``session_dir/cluster_token``, then the CLI
+    state file (~/.ray_tpu/cluster.json) token_file entry. Returns the token
+    or None. No-op when RAY_TPU_CLUSTER_TOKEN is already exported.
+    """
+    if os.environ.get("RAY_TPU_CLUSTER_TOKEN"):
+        return os.environ["RAY_TPU_CLUSTER_TOKEN"]
+    candidates = []
+    if session_dir:
+        candidates.append(os.path.join(session_dir, "cluster_token"))
+    state_file = os.path.expanduser("~/.ray_tpu/cluster.json")
+    try:
+        with open(state_file) as f:
+            state = json.load(f)
+        if state.get("token_file"):
+            candidates.append(state["token_file"])
+        if state.get("session_dir"):
+            candidates.append(os.path.join(state["session_dir"], "cluster_token"))
+    except (OSError, ValueError):
+        pass
+    for path in candidates:
+        try:
+            with open(path) as f:
+                token = f.read().strip()
+            if token:
+                os.environ["RAY_TPU_CLUSTER_TOKEN"] = token
+                return token
+        except OSError:
+            continue
+    return None
+
+
 def _wait_port_file(path: str, timeout: float = 30.0) -> list:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -77,11 +111,26 @@ class NodeProcesses:
             # Cluster-scoped RPC auth: every process spawned from here (and
             # every driver sharing this env) inherits the token; rpcio
             # rejects unauthenticated connects (see rpcio.py preamble).
-            # Remote drivers must export RAY_TPU_CLUSTER_TOKEN themselves.
             import secrets
 
             os.environ["RAY_TPU_CLUSTER_TOKEN"] = secrets.token_hex(16)
         self.session_dir = session_dir or _make_session_dir()
+        # Persist the token (0600) so separately launched processes — the
+        # CLI after `start --head`, drivers using init(address=...), worker
+        # raylets joining via `start --address` on the same host — can load
+        # it instead of silently failing auth. Cross-host joins still export
+        # RAY_TPU_CLUSTER_TOKEN manually (the CLI prints the hint).
+        token = os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
+        if token:
+            self.token_file = os.path.join(self.session_dir, "cluster_token")
+            if not os.path.exists(self.token_file):
+                fd = os.open(
+                    self.token_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+                )
+                with os.fdopen(fd, "w") as f:
+                    f.write(token)
+        else:
+            self.token_file = None
         self.logs = os.path.join(self.session_dir, "logs")
         os.makedirs(self.logs, exist_ok=True)
         self.gcs_host = gcs_host

@@ -49,6 +49,14 @@ def _max_msg() -> int:
 # hashes "" — so a token-bearing client and a token-less server can never
 # misparse each other's streams; they fail the digest compare and close.
 # Plays the role of the reference's cluster auth token scoping.
+#
+# Threat model: this is a static bearer credential on a trusted LAN — it
+# scopes which processes belong to the cluster and keeps stray/stale
+# processes from delivering pickles. It is NOT a defense against an
+# on-path network attacker: there is no nonce/challenge (an observed
+# preamble replays) and clients do not authenticate the server. That
+# matches the reference's cluster-token posture; deployments that face
+# untrusted networks must wrap transport in TLS/VPN at a lower layer.
 
 _AUTH_MAGIC = b"RTPU1"
 _AUTH_LEN = len(_AUTH_MAGIC) + 64

@@ -363,6 +363,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_task_retries: int = 0,
         max_concurrency: int = 1,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         lifetime: Optional[str] = None,
         name: Optional[str] = None,
         namespace: Optional[str] = None,
@@ -393,6 +394,7 @@ class CoreWorker:
             max_restarts=max_restarts,
             max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
+            concurrency_groups=dict(concurrency_groups or {}),
             lifetime=lifetime,
             name_registered=name,
             namespace=namespace or self.namespace,
@@ -466,6 +468,7 @@ class CoreWorker:
         kwargs=None,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_actor_task(ActorID(actor_id))
         with self._lock:
@@ -487,6 +490,7 @@ class CoreWorker:
             seq_no=seq,
             caller_id=self.client_id.encode(),
             tracing_ctx=_tracing_ctx(),
+            concurrency_group=concurrency_group,
         )
         refs = self._register_returns(spec)
         self.io.call_soon(
@@ -544,7 +548,11 @@ class CoreWorker:
         # container so dropping the (possibly never-materialized) list
         # frees the items (_maybe_free releases _contains pins).
         dyn_oids = p.get("dynamic_return_oids") or ()
-        if dyn_oids:
+        # Adopt only on the first (spec-bearing) delivery: the spilled-task
+        # at-least-once resubmission path can deliver task_result twice, and
+        # re-adopting would re-pin items under a ref-list that may already
+        # have been freed, leaking escape pins.
+        if dyn_oids and spec is not None:
             list_oid = ObjectID.from_index(tid, 1).binary()
             tokens = []
             for oid in dyn_oids:
@@ -882,7 +890,8 @@ class CoreWorker:
         oid = ref.binary()
         try:
             ok = await self.raylet.request(
-                "pull_object", {"object_id": oid, "timeout": 10.0}
+                "pull_object",
+                {"object_id": oid, "timeout": cfg.object_pull_timeout_s},
             )
             if ok.get("ok") and object_store.object_exists(
                 self.store_dir, ref.id()

@@ -95,6 +95,12 @@ def init(
             gcs_host, gcs_port = address.rsplit(":", 1)
         else:
             gcs_host, gcs_port = address.rsplit(":", 1)
+            # Separately launched driver: pick up the head's persisted
+            # cluster token (session dir / CLI state file) when the env
+            # doesn't already carry one, else rpcio auth silently drops us.
+            from ray_tpu._private.node import load_cluster_token
+
+            load_cluster_token()
             # Connecting to an existing cluster: find/start a local raylet is
             # out of scope round 1 — connect to the head's raylet via GCS.
             import asyncio
@@ -387,10 +393,12 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts):
         num_returns = opts.get("num_returns", self._num_returns)
@@ -398,11 +406,17 @@ class ActorMethod:
             raise ValueError(
                 "num_returns='dynamic' is not supported for actor tasks"
             )
-        return ActorMethod(self._handle, self._name, num_returns=num_returns)
+        return ActorMethod(
+            self._handle, self._name, num_returns=num_returns,
+            concurrency_group=opts.get(
+                "concurrency_group", self._concurrency_group
+            ),
+        )
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(
-            self._name, args, kwargs, num_returns=self._num_returns
+            self._name, args, kwargs, num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
 
     def bind(self, *args, **kwargs):
@@ -421,14 +435,27 @@ class ActorHandle:
     """ray parity: python/ray/actor.py ActorHandle."""
 
     def __init__(self, actor_id: bytes, methods: Optional[dict] = None,
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 method_groups: Optional[dict] = None,
+                 concurrency_groups: Optional[dict] = None):
         self._actor_id = actor_id
         self._methods = methods or {}
         self._max_task_retries = max_task_retries
+        self._method_groups = method_groups or {}
+        self._concurrency_groups = concurrency_groups or {}
 
-    def _invoke(self, method_name, args, kwargs, num_returns=1):
+    def _invoke(self, method_name, args, kwargs, num_returns=1,
+                concurrency_group=None):
         global_worker.check_connected()
         cw = global_worker.core_worker
+        group = concurrency_group or self._method_groups.get(method_name)
+        if group is not None and self._concurrency_groups and (
+            group not in self._concurrency_groups
+        ):
+            raise ValueError(
+                f"concurrency group {group!r} not declared on this actor "
+                f"(declared: {sorted(self._concurrency_groups)})"
+            )
         refs = cw.submit_actor_task(
             self._actor_id,
             method_name,
@@ -436,6 +463,7 @@ class ActorHandle:
             kwargs=kwargs,
             num_returns=num_returns,
             max_task_retries=self._max_task_retries,
+            concurrency_group=group,
         )
         if num_returns == 1:
             return refs[0]
@@ -448,13 +476,18 @@ class ActorHandle:
         # convention for internal remote methods (e.g. _rt_init_collective).
         if name.startswith("_") and not name.startswith("_rt_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, num_returns=self._methods.get(name, 1))
+        return ActorMethod(
+            self, name, num_returns=self._methods.get(name, 1),
+            concurrency_group=self._method_groups.get(name),
+        )
 
     def __repr__(self):
         return f"ActorHandle({ActorID(self._actor_id).hex()[:16]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._methods, self._max_task_retries))
+        return (ActorHandle, (self._actor_id, self._methods,
+                              self._max_task_retries, self._method_groups,
+                              self._concurrency_groups))
 
     def _actor_id_hex(self):
         return ActorID(self._actor_id).hex()
@@ -493,6 +526,27 @@ class ActorClass:
             for name, m in vars(self._cls).items()
             if callable(m) and hasattr(m, "__ray_num_returns__")
         }
+        # @ray_tpu.method(concurrency_group="io") annotations + the declared
+        # groups (ray parity: concurrency_group_manager.h; groups are
+        # enforced by per-group semaphores in executor.py).
+        method_groups = {
+            name: getattr(m, "__ray_concurrency_group__")
+            for name, m in vars(self._cls).items()
+            if callable(m) and hasattr(m, "__ray_concurrency_group__")
+        }
+        groups = dict(opts.get("concurrency_groups") or {})
+        for gname, cap in groups.items():
+            if not isinstance(cap, int) or cap < 1:
+                raise ValueError(
+                    f"concurrency_groups[{gname!r}] must be a positive int, "
+                    f"got {cap!r}"
+                )
+        for mname, gname in method_groups.items():
+            if gname not in groups:
+                raise ValueError(
+                    f"method {mname!r} declares concurrency_group {gname!r} "
+                    f"but the actor only declares {sorted(groups)}"
+                )
         actor_id = cw.create_actor(
             self._cls,
             args,
@@ -502,13 +556,16 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=groups,
             lifetime=opts.get("lifetime"),
             name=opts.get("name"),
             namespace=opts.get("namespace"),
             runtime_env=_prepare_runtime_env(opts.get("runtime_env")),
         )
         return ActorHandle(actor_id, methods=method_returns,
-                           max_task_retries=opts.get("max_task_retries", 0))
+                           max_task_retries=opts.get("max_task_retries", 0),
+                           method_groups=method_groups,
+                           concurrency_groups=groups)
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ClassNode
@@ -542,10 +599,13 @@ def remote(*args, **kwargs):
 
 
 def method(**opts):
-    """ray parity: ray.method — annotate num_returns on actor methods."""
+    """ray parity: ray.method — annotate num_returns / concurrency_group
+    on actor methods."""
 
     def decorator(m):
         m.__ray_num_returns__ = opts.get("num_returns", 1)
+        if "concurrency_group" in opts:
+            m.__ray_concurrency_group__ = opts["concurrency_group"]
         return m
 
     return decorator

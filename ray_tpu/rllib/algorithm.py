@@ -160,6 +160,13 @@ class Algorithm(Trainable):
         # to the CPU backend.
         runner_cls = ray_tpu.remote(
             num_cpus=0.5,
+            # Survive transient worker death (memory-monitor kills under
+            # concurrent Tune trials): the actor restarts in place and the
+            # in-flight call retries, so _sync_weights never sees a dead
+            # actor for a one-off kill (ray parity: FaultTolerantActorManager
+            # + max_restarts on rollout workers).
+            max_restarts=2,
+            max_task_retries=2,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
         )(EnvRunner)
         self._runner_factory = lambda i, replacement=False: runner_cls.remote(
@@ -240,14 +247,22 @@ class Algorithm(Trainable):
             log.warning("replaced %d dead env runner(s)", replaced)
         return replaced
 
-    def _with_runner_ft(self, fn):
-        """Run a fan-out once; on failure restore dead runners and retry."""
-        try:
-            return fn()
-        except Exception:
-            if not self._restore_dead_runners():
-                raise
-            return fn()
+    def _with_runner_ft(self, fn, attempts: int = 3):
+        """Run a fan-out; on failure restore dead runners and retry.
+
+        Up to ``attempts`` tries total: each failure triggers a probe+replace
+        pass, and the retry re-issues the whole fan-out against the (possibly
+        refreshed) runner set. A failure with no dead runner found is not
+        retriable — it is a real application error, re-raise it."""
+        last = None
+        for i in range(attempts):
+            try:
+                return fn()
+            except Exception as e:
+                last = e
+                if not self._restore_dead_runners():
+                    raise
+        raise last
 
     def _sync_weights(self):
         weights = ray_tpu.put(self.learner.get_weights())
@@ -454,6 +469,8 @@ class TD3(Algorithm):
         self.learner = self._learner_cls(self.module, cfg)
         runner_cls = ray_tpu.remote(
             num_cpus=0.5,
+            max_restarts=2,
+            max_task_retries=2,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
         )(ContinuousEnvRunner)
         # a REPLACEMENT runner mid-training must not redo its uniform-

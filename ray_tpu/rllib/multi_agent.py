@@ -263,6 +263,8 @@ class MultiAgentPPO:
         }
         runner_cls = ray_tpu.remote(
             num_cpus=0.5,
+            max_restarts=2,
+            max_task_retries=2,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
         )(MultiAgentEnvRunner)
         self.runners = [

@@ -43,6 +43,11 @@ def _resolve_address(args) -> str:
     if not addr:
         sys.exit("no cluster address: pass --address, set RAY_TPU_GCS_ADDR, "
                  "or run `ray_tpu start --head` on this machine first")
+    # Load the persisted cluster token so this process authenticates; a
+    # missing token would be silently dropped by rpcio's auth preamble.
+    from ray_tpu._private.node import load_cluster_token
+
+    load_cluster_token()
     return addr
 
 
@@ -59,6 +64,7 @@ def cmd_start(args):
         state = {
             "address": node.address,
             "session_dir": node.session_dir,
+            "token_file": node.token_file,
             "pids": [node.gcs_proc.pid, node.raylet_proc.pid],
             "started_at": time.time(),
         }
@@ -67,6 +73,10 @@ def cmd_start(args):
         print(f"session dir: {node.session_dir}")
         print("connect drivers with "
               f"ray_tpu.init(address=\"{node.address}\")")
+        if node.token_file:
+            token = os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
+            print("to join from another machine, first run:\n"
+                  f"  export RAY_TPU_CLUSTER_TOKEN={token}")
     else:
         address = _resolve_address(args)
         host, port = address.rsplit(":", 1)
