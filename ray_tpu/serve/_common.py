@@ -16,6 +16,13 @@ SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 DEFAULT_APP_NAME = "default"
 
+# GCS-pubsub channels the controller pushes config changes on (long-poll
+# analog, ray parity: serve/_private/long_poll.py:186): handles subscribe
+# to replica-set changes, proxies to route-table changes. Consumers keep a
+# slow poll as the safety net; the push makes updates near-instant.
+REPLICA_PUSH_CHANNEL = "serve:replicas"
+ROUTES_PUSH_CHANNEL = "serve:routes"
+
 
 def _default_graceful_shutdown_s() -> float:
     from ray_tpu._private.config import GLOBAL_CONFIG

@@ -17,6 +17,8 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve._common import (
+    ROUTES_PUSH_CHANNEL,
+    REPLICA_PUSH_CHANNEL,
     AutoscalingConfig,
     DeploymentConfig,
     ReplicaInfo,
@@ -37,6 +39,10 @@ class _DeploymentState:
         self.config = config
         self.serialized_init = serialized_init
         self.replicas: Dict[str, Any] = {}  # actor_name -> handle
+        # replica-reported queue lengths, refreshed each control-loop pass;
+        # handles read these for load-aware p2c routing (ray parity:
+        # _private/router.py:262 replica queue-len probes)
+        self.loads: Dict[str, float] = {}
         self.target = config.num_replicas
         self.autoscaling = AutoscalingConfig.from_dict(
             config.autoscaling_config
@@ -110,6 +116,7 @@ class ServeController:
         # do them after releasing the lock so control RPCs stay responsive
         for st in to_stop:
             self._stop_all(st)
+        self._push_routes()
         return True
 
     def delete_app(self, app_name: str):
@@ -119,6 +126,8 @@ class ServeController:
         if app:
             for st in app.values():
                 self._stop_all(st)
+                self._push_replicas(st)
+        self._push_routes()
         return True
 
     def wait_for_ready(self, app_name: str, timeout_s: float = 60.0) -> bool:
@@ -145,6 +154,17 @@ class ServeController:
             # during a rolling update, route to the old version until the
             # new one has live replicas
             return list(st.replicas.keys()) or list(st.draining.keys())
+
+    def get_replica_state(self, app_name: str, deployment: str) -> dict:
+        """Replica names + reported queue lengths in one round trip
+        (handles route with p2c over these loads)."""
+        with self._lock:
+            app = self._apps.get(app_name) or {}
+            st = app.get(deployment)
+            if st is None:
+                return {"names": [], "loads": {}}
+            names = list(st.replicas.keys()) or list(st.draining.keys())
+            return {"names": names, "loads": dict(st.loads)}
 
     def get_routes(self) -> Dict[str, tuple]:
         """route_prefix -> (app_name, ingress deployment)."""
@@ -182,12 +202,32 @@ class ServeController:
         return True
 
     # ------------------------------------------------------------------
+    # config push (long-poll analog)
+    # ------------------------------------------------------------------
+    def _publish(self, channel: str, message):
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            global_worker.core_worker.publish(channel, message)
+        except Exception:  # pubsub is an optimization; polling covers us
+            logger.debug("serve config push failed", exc_info=True)
+
+    def _push_replicas(self, st: _DeploymentState):
+        self._publish(
+            REPLICA_PUSH_CHANNEL, {"app": st.app, "deployment": st.name}
+        )
+
+    def _push_routes(self):
+        self._publish(ROUTES_PUSH_CHANNEL, {"routes": self.get_routes()})
+
+    # ------------------------------------------------------------------
     # reconciliation
     # ------------------------------------------------------------------
     def _control_loop(self):
         while not self._shutdown.is_set():
             try:
                 self._reconcile_once()
+                self._collect_loads()
                 self._autoscale_once()
             except Exception:  # noqa: BLE001 — loop must survive
                 logger.exception("serve control loop iteration failed")
@@ -201,89 +241,136 @@ class ServeController:
                 st for app in self._apps.values() for st in app.values()
             ]
         for st in states:
-            # scale up (bounded per pass; a constructor that keeps failing
-            # marks the deployment broken instead of spinning the loop and
-            # starving every other deployment)
-            while len(st.replicas) < st.target and not st.broken:
-                name = (
-                    f"SERVE_REPLICA::{st.app}#{st.name}#"
-                    f"{uuid.uuid4().hex[:6]}"
-                )
-                from ray_tpu.serve.replica import Replica
+            before = set(st.replicas)
+            self._reconcile_state(st)
+            if set(st.replicas) != before:
+                self._push_replicas(st)
 
-                opts = st.config.replica_actor_options()
-                actor_cls = ray_tpu.remote(
-                    name=name,
-                    max_concurrency=st.config.max_ongoing_requests,
-                    **opts,
-                )(Replica)
-                handle = actor_cls.remote(
-                    st.serialized_init, st.name, st.app,
-                    st.config.user_config, st.config.max_ongoing_requests,
-                )
-                # block until constructed so wait_for_ready means servable
+    def _reconcile_state(self, st: _DeploymentState):
+        import ray_tpu
+
+        # scale up (bounded per pass; a constructor that keeps failing
+        # marks the deployment broken instead of spinning the loop and
+        # starving every other deployment)
+        while len(st.replicas) < st.target and not st.broken:
+            name = (
+                f"SERVE_REPLICA::{st.app}#{st.name}#"
+                f"{uuid.uuid4().hex[:6]}"
+            )
+            from ray_tpu.serve.replica import Replica
+
+            opts = st.config.replica_actor_options()
+            actor_cls = ray_tpu.remote(
+                name=name,
+                max_concurrency=st.config.max_ongoing_requests,
+                **opts,
+            )(Replica)
+            handle = actor_cls.remote(
+                st.serialized_init, st.name, st.app,
+                st.config.user_config, st.config.max_ongoing_requests,
+                replica_name=name,
+            )
+            # block until constructed so wait_for_ready means servable
+            try:
+                ray_tpu.get(handle.check_health.remote(), timeout=60)
+            except Exception:
+                logger.exception("replica %s failed to start", name)
                 try:
-                    ray_tpu.get(handle.check_health.remote(), timeout=60)
+                    ray_tpu.kill(handle)
                 except Exception:
-                    logger.exception("replica %s failed to start", name)
+                    pass
+                st.consecutive_start_failures += 1
+                if st.consecutive_start_failures >= 3:
+                    logger.error(
+                        "deployment %s/%s: %d consecutive replica start "
+                        "failures; giving up until redeployed",
+                        st.app, st.name, st.consecutive_start_failures,
+                    )
+                    st.broken = True
+                break
+            st.consecutive_start_failures = 0
+            with self._lock:
+                # the app may have been deleted/redeployed while we
+                # blocked on the health check: registering on a stale
+                # state would leak a live named replica actor
+                current = (self._apps.get(st.app) or {}).get(st.name)
+                if current is not st:
                     try:
                         ray_tpu.kill(handle)
                     except Exception:
                         pass
-                    st.consecutive_start_failures += 1
-                    if st.consecutive_start_failures >= 3:
-                        logger.error(
-                            "deployment %s/%s: %d consecutive replica start "
-                            "failures; giving up until redeployed",
-                            st.app, st.name, st.consecutive_start_failures,
-                        )
-                        st.broken = True
                     break
-                st.consecutive_start_failures = 0
-                with self._lock:
-                    # the app may have been deleted/redeployed while we
-                    # blocked on the health check: registering on a stale
-                    # state would leak a live named replica actor
-                    current = (self._apps.get(st.app) or {}).get(st.name)
-                    if current is not st:
-                        try:
-                            ray_tpu.kill(handle)
-                        except Exception:
-                            pass
-                        break
-                    st.replicas[name] = handle
-            # rolling update: drain old-version replicas once at target
-            if st.draining and len(st.replicas) >= st.target:
-                with self._lock:
-                    drained, st.draining = dict(st.draining), {}
-                for handle in drained.values():
-                    self._graceful_stop(st, handle)
-            # scale down
-            while len(st.replicas) > st.target:
-                with self._lock:
-                    name, handle = next(iter(st.replicas.items()))
-                    del st.replicas[name]
+                st.replicas[name] = handle
+        # rolling update: drain old-version replicas once at target
+        if st.draining and len(st.replicas) >= st.target:
+            with self._lock:
+                drained, st.draining = dict(st.draining), {}
+            for handle in drained.values():
                 self._graceful_stop(st, handle)
-            # health check, on the configured period (not every loop pass)
-            now = time.time()
-            if now - getattr(st, "_last_health_check", 0.0) >= \
-                    st.config.health_check_period_s:
-                st._last_health_check = now
-                for name, handle in list(st.replicas.items()):
+        # scale down
+        while len(st.replicas) > st.target:
+            with self._lock:
+                name, handle = next(iter(st.replicas.items()))
+                del st.replicas[name]
+            self._graceful_stop(st, handle)
+        # health check, on the configured period (not every loop pass)
+        now = time.time()
+        if now - getattr(st, "_last_health_check", 0.0) >= \
+                st.config.health_check_period_s:
+            st._last_health_check = now
+            for name, handle in list(st.replicas.items()):
+                try:
+                    ray_tpu.get(handle.check_health.remote(), timeout=30)
+                except Exception:
+                    logger.warning("replica %s unhealthy; replacing", name)
+                    with self._lock:
+                        st.replicas.pop(name, None)
                     try:
-                        ray_tpu.get(handle.check_health.remote(), timeout=30)
+                        ray_tpu.kill(handle)
                     except Exception:
-                        logger.warning("replica %s unhealthy; replacing", name)
-                        with self._lock:
-                            st.replicas.pop(name, None)
-                        try:
-                            ray_tpu.kill(handle)
-                        except Exception:
-                            pass
+                        pass
 
-    def _autoscale_once(self):
+    def _collect_loads(self):
+        """Refresh per-replica queue lengths for every deployment (handles
+        read them through get_replica_state for load-aware routing; the
+        autoscaler reads them for scaling decisions).
+
+        All probes fan out first and share one 10s budget, so a few wedged
+        replicas cannot stall the control loop for 10s each. A replica
+        that does not answer scores +inf — handles must steer AWAY from an
+        unresponsive replica, not prefer it as idle — until the health
+        check replaces it."""
         import ray_tpu
 
+        with self._lock:
+            states = [
+                st for app in self._apps.values() for st in app.values()
+            ]
+        probes = []  # (state, replica_name, ref)
+        for st in states:
+            if not st.replicas:
+                st.loads = {}
+                continue
+            for name, h in list(st.replicas.items()):
+                probes.append((st, name, h.get_metrics.remote()))
+        if not probes:
+            return
+        new_loads: Dict[int, Dict[str, float]] = {}
+        deadline = time.time() + 10.0
+        for st, name, ref in probes:
+            loads = new_loads.setdefault(id(st), {})
+            try:
+                remaining = max(0.1, deadline - time.time())
+                loads[name] = float(
+                    ray_tpu.get(ref, timeout=remaining)["ongoing"]
+                )
+            except Exception:
+                loads[name] = float("inf")
+        for st in states:
+            if id(st) in new_loads:
+                st.loads = new_loads[id(st)]
+
+    def _autoscale_once(self):
         with self._lock:
             states = [
                 st for app in self._apps.values() for st in app.values()
@@ -291,16 +378,13 @@ class ServeController:
             ]
         for st in states:
             ac = st.autoscaling
-            handles = list(st.replicas.values())
-            if not handles:
+            if not st.replicas:
                 continue
-            try:
-                metrics = ray_tpu.get(
-                    [h.get_metrics.remote() for h in handles], timeout=10
-                )
-            except Exception:
-                continue
-            ongoing = sum(m["ongoing"] for m in metrics)
+            # inf marks an unresponsive replica (routing signal); it must
+            # not launch max_replicas here
+            ongoing = sum(
+                v for v in st.loads.values() if v != float("inf")
+            )
             desired = max(
                 ac.min_replicas,
                 min(

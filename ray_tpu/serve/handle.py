@@ -1,10 +1,14 @@
 """DeploymentHandle: the client-side router to a deployment's replicas.
 
 Reference parity: ray python/ray/serve/handle.py (DeploymentHandle /
-DeploymentResponse) + _private/router.py:262 (PowerOfTwoChoicesReplicaScheduler)
-— the handle keeps a local in-flight count per replica and picks the less
-loaded of two random replicas; the replica set refreshes from the
-controller on an interval and immediately on routing failures.
+DeploymentResponse / DeploymentResponseGenerator) + _private/router.py:262
+(PowerOfTwoChoicesReplicaScheduler) — the handle picks the less loaded of
+two random replicas, scoring each by local in-flight count PLUS the
+replica-reported queue length (collected by the controller's control loop),
+so many independent handles/proxies converge instead of each hot-spotting
+on its own view. The replica set refreshes from the controller on an
+interval, immediately on routing failures, and is invalidated by the
+controller's pubsub push (ray parity: _private/long_poll.py:186).
 """
 
 from __future__ import annotations
@@ -13,7 +17,7 @@ import random
 import time
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.serve._common import SERVE_CONTROLLER_NAME
+from ray_tpu.serve._common import REPLICA_PUSH_CHANNEL, SERVE_CONTROLLER_NAME
 
 _REFRESH_PERIOD_S = 1.0
 
@@ -45,9 +49,28 @@ class DeploymentResponse:
         import ray_tpu
 
         try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
+            out = ray_tpu.get(self._ref, timeout=timeout_s)
         finally:
             self._settle()
+        from ray_tpu.serve.replica import STREAM_MARKER
+
+        if isinstance(out, dict) and STREAM_MARKER in out:
+            # generator deployment called without stream=True: stop the
+            # producer and tell the caller how to consume it — leaking the
+            # marker would hand users an internal dict and park a stream
+            # until the TTL reap
+            info = out[STREAM_MARKER]
+            try:
+                ray_tpu.get_actor(info["replica"]).cancel_stream.remote(
+                    info["stream_id"]
+                )
+            except Exception:
+                pass
+            raise TypeError(
+                "this deployment method is a generator; call it with "
+                ".options(stream=True).remote(...) and iterate the result"
+            )
+        return out
 
     @property
     def ref(self):
@@ -55,32 +78,221 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call (ray parity:
+    serve.handle.DeploymentResponseGenerator). Pulls chunk batches from the
+    replica; iteration blocks on the first chunk of each batch."""
+
+    def __init__(self, ref, on_settle=None, timeout_s: float = 60.0):
+        self._ref = ref
+        self._on_settle = on_settle
+        self._timeout_s = timeout_s
+        self._actor = None
+        self._stream_id = None
+        self._buffer: List[Any] = []
+        self._done = False
+        self._settled = False
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            if self._on_settle:
+                self._on_settle()
+
+    def _ensure_started(self):
+        if self._actor is not None or self._done:
+            return
+        import ray_tpu
+        from ray_tpu.serve.replica import STREAM_MARKER
+
+        first = ray_tpu.get(self._ref, timeout=self._timeout_s)
+        if not (isinstance(first, dict) and STREAM_MARKER in first):
+            # non-generator target: degrade to a one-item stream
+            self._buffer = [first]
+            self._done = True
+            self._settle()
+            return
+        info = first[STREAM_MARKER]
+        self._stream_id = info["stream_id"]
+        self._actor = ray_tpu.get_actor(info["replica"])
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        self._ensure_started()
+        if self._buffer:
+            return self._buffer.pop(0)
+        if self._done:
+            raise StopIteration
+        try:
+            items, done = ray_tpu.get(
+                self._actor.next_chunks.remote(self._stream_id),
+                timeout=self._timeout_s,
+            )
+        except Exception:
+            self._done = True
+            self._settle()
+            raise
+        self._buffer.extend(items)
+        if done:
+            self._done = True
+            self._settle()
+        if self._buffer:
+            return self._buffer.pop(0)
+        if self._done:
+            raise StopIteration
+        return self.__next__()
+
+    def cancel(self):
+        """Abandon the stream; the replica stops the producer."""
+        if self._actor is not None and not self._done:
+            try:
+                self._actor.cancel_stream.remote(self._stream_id)
+            except Exception:
+                pass
+        self._done = True
+        self._settle()
+
+    def __del__(self):
+        try:
+            self.cancel()
+        except Exception:
+            pass
+
+
+class _PushRegistry:
+    """One process-wide pubsub subscription fanning replica-set pushes out
+    to every live _RouterState (weakly referenced, so dead handles — e.g.
+    repeatedly unpickled request arguments — do not pin states or grow the
+    worker's callback list)."""
+
+    def __init__(self):
+        import weakref
+
+        self._states: "weakref.WeakSet" = weakref.WeakSet()
+        self._subscribed = False
+
+    def register(self, state: "_RouterState") -> bool:
+        self._states.add(state)
+        if self._subscribed:
+            return True
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            def on_push(msg):
+                key = (msg.get("app"), msg.get("deployment"))
+                for st in list(self._states):
+                    if (st.app_name, st.deployment_name) == key:
+                        st.last_refresh = 0.0  # next routing refreshes
+
+            global_worker.core_worker.subscribe(REPLICA_PUSH_CHANNEL, on_push)
+            self._subscribed = True
+        except Exception:
+            return False  # not connected yet; polling still covers us
+        return True
+
+
+_push_registry = _PushRegistry()
+
+
+class _RouterState:
+    """Replica cache + load scores for one (app, deployment), shared by a
+    handle and every derivative it creates via options()/__getattr__ — one
+    subscription, one cache, consistent in-flight accounting."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replicas: List[Any] = []
+        self.inflight: Dict[str, int] = {}
+        self.reported: Dict[str, float] = {}
+        self.last_refresh = 0.0
+        self.push_subscribed = False
+
+    def _subscribe_push(self):
+        """Invalidate the replica cache the moment the controller pushes a
+        replica-set change for this deployment (long-poll analog)."""
+        if self.push_subscribed:
+            return
+        self.push_subscribed = _push_registry.register(self)
+
+    def refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and self.replicas and (
+            now - self.last_refresh < _REFRESH_PERIOD_S
+        ):
+            return
+        import ray_tpu
+
+        self._subscribe_push()
+        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        state = ray_tpu.get(
+            controller.get_replica_state.remote(
+                self.app_name, self.deployment_name
+            ),
+            timeout=30,
+        )
+        names, loads = state["names"], state.get("loads", {})
+        replicas = []
+        for n in names:
+            try:
+                replicas.append((n, ray_tpu.get_actor(n)))
+            except Exception:
+                pass
+        self.replicas = replicas
+        self.inflight = {n: self.inflight.get(n, 0) for n, _ in replicas}
+        self.reported = {n: float(loads.get(n, 0.0)) for n, _ in replicas}
+        self.last_refresh = now
+
+    def score(self, name: str) -> float:
+        # reported queue length (global view, ~1 control-loop period stale)
+        # + local in-flight (instant view of our own traffic)
+        return self.reported.get(name, 0.0) + self.inflight.get(name, 0)
+
+    def pick(self):
+        """Power-of-two-choices on reported + local load."""
+        if not self.replicas:
+            raise RuntimeError(
+                f"no replicas for {self.app_name}/{self.deployment_name}"
+            )
+        if len(self.replicas) == 1:
+            return self.replicas[0]
+        a, b = random.sample(self.replicas, 2)
+        return a if self.score(a[0]) <= self.score(b[0]) else b
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False,
+                 _state: Optional[_RouterState] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
-        self._replicas: List[Any] = []
-        self._inflight: Dict[str, int] = {}
-        self._last_refresh = 0.0
+        self._stream = stream
+        self._state = _state or _RouterState(app_name, deployment_name)
 
     # handles are pickled into other replicas; drop live actor handles
     def __getstate__(self):
         d = dict(self.__dict__)
-        d["_replicas"] = []
-        d["_inflight"] = {}
-        d["_last_refresh"] = 0.0
+        d["_state"] = None
         return d
 
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._state = _RouterState(self.app_name, self.deployment_name)
+
     def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
                 **_ignored) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self.app_name,
-                             method_name or self._method)
-        h._replicas = self._replicas
-        h._inflight = self._inflight
-        h._last_refresh = self._last_refresh
-        return h
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method,
+            stream=self._stream if stream is None else stream,
+            _state=self._state,
+        )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -89,64 +301,33 @@ class DeploymentHandle:
 
     # ------------------------------------------------------------------
     def _refresh(self, force: bool = False):
-        now = time.monotonic()
-        if not force and self._replicas and (
-            now - self._last_refresh < _REFRESH_PERIOD_S
-        ):
-            return
-        import ray_tpu
+        self._state.refresh(force=force)
 
-        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
-        names = ray_tpu.get(
-            controller.get_replica_names.remote(
-                self.app_name, self.deployment_name
-            ),
-            timeout=30,
-        )
-        replicas = []
-        for n in names:
-            try:
-                replicas.append((n, ray_tpu.get_actor(n)))
-            except Exception:
-                pass
-        self._replicas = replicas
-        self._inflight = {n: self._inflight.get(n, 0) for n, _ in replicas}
-        self._last_refresh = now
-
-    def _pick(self):
-        """Power-of-two-choices on local in-flight counts."""
-        if not self._replicas:
-            raise RuntimeError(
-                f"no replicas for {self.app_name}/{self.deployment_name}"
-            )
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
-        return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) \
-            else b
-
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        st = self._state
         deadline = time.monotonic() + 30.0
         last_err = None
         while time.monotonic() < deadline:
             try:
-                self._refresh()
-                name, actor = self._pick()
+                st.refresh()
+                name, actor = st.pick()
             except Exception as e:  # controller not up yet / no replicas
                 last_err = e
                 time.sleep(0.1)
                 continue
             try:
                 ref = actor.handle_request.remote(self._method, args, kwargs)
-                self._inflight[name] = self._inflight.get(name, 0) + 1
+                st.inflight[name] = st.inflight.get(name, 0) + 1
 
                 def settle(n=name):
-                    self._inflight[n] = max(0, self._inflight.get(n, 1) - 1)
+                    st.inflight[n] = max(0, st.inflight.get(n, 1) - 1)
 
+                if self._stream:
+                    return DeploymentResponseGenerator(ref, on_settle=settle)
                 return DeploymentResponse(ref, on_settle=settle)
             except Exception as e:
                 last_err = e
-                self._refresh(force=True)
+                st.refresh(force=True)
         raise RuntimeError(
             f"could not route request to {self.deployment_name}: {last_err}"
         )

@@ -4,7 +4,10 @@ Reference parity: ray python/ray/serve/_private/http_proxy.py:888
 (HTTPProxyActor, ASGI/uvicorn) — here an aiohttp server inside an actor:
 requests are matched to the longest route prefix from the controller's
 routing table and forwarded to the app's ingress deployment handle; dict/
-list/str results render as JSON/text, bytes pass through.
+list/str results render as JSON/text, bytes pass through. Generator
+deployments stream chunk-by-chunk over a chunked HTTP response
+(http_proxy.py:395), and the route table updates by controller pubsub
+push (long_poll.py:186) with a slow poll as the safety net.
 """
 
 from __future__ import annotations
@@ -14,7 +17,11 @@ import json
 import threading
 from typing import Dict, Optional, Tuple
 
-from ray_tpu.serve._common import Request
+from ray_tpu.serve._common import ROUTES_PUSH_CHANNEL, Request
+
+# with push in place the poll is only a safety net
+_ROUTE_POLL_TTL_S = 10.0
+_ROUTE_POLL_TTL_UNPUSHED_S = 1.0
 
 
 class HTTPProxy:
@@ -26,6 +33,7 @@ class HTTPProxy:
         self._actual_port: Optional[int] = None
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._routes_fetched_at = 0.0
+        self._push_subscribed = False
         self._handles = {}
         # dedicated pool: the default asyncio executor is ~32 threads, and
         # every in-flight request blocks one for up to its full timeout
@@ -35,6 +43,7 @@ class HTTPProxy:
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        self._subscribe_push()
 
     def ready(self) -> int:
         self._ready.wait(timeout=30)
@@ -69,14 +78,38 @@ class HTTPProxy:
             await asyncio.sleep(3600)
 
     # ------------------------------------------------------------------
+    def _subscribe_push(self):
+        """Route-table changes arrive by controller push; the TTL poll
+        stays as the fallback (and primary path until connected)."""
+        if self._push_subscribed:
+            return
+        try:
+            import time
+
+            from ray_tpu._private.worker import global_worker
+
+            def on_push(msg):
+                routes = msg.get("routes")
+                if isinstance(routes, dict):
+                    self._routes = {
+                        k: tuple(v) for k, v in routes.items()
+                    }
+                    self._routes_fetched_at = time.monotonic()
+
+            global_worker.core_worker.subscribe(ROUTES_PUSH_CHANNEL, on_push)
+            self._push_subscribed = True
+        except Exception:
+            pass
+
     async def _refresh_routes(self, force: bool = False):
         import time
 
         import ray_tpu
 
-        # 1s TTL cache: a controller round-trip per request would put the
-        # single controller actor on the hot path
-        if not force and time.monotonic() - self._routes_fetched_at < 1.0:
+        self._subscribe_push()
+        ttl = _ROUTE_POLL_TTL_S if self._push_subscribed else \
+            _ROUTE_POLL_TTL_UNPUSHED_S
+        if not force and time.monotonic() - self._routes_fetched_at < ttl:
             return
         loop = asyncio.get_running_loop()
 
@@ -100,6 +133,8 @@ class HTTPProxy:
 
     async def _handle(self, request):
         from aiohttp import web
+
+        from ray_tpu.serve.replica import STREAM_MARKER
 
         await self._refresh_routes()
         m = self._match(request.path)
@@ -128,12 +163,16 @@ class HTTPProxy:
         loop = asyncio.get_running_loop()
 
         def call():
+            import ray_tpu
+
             # a replica can die between routing and execution (rolling
-            # update, crash) — retry on a freshly-refreshed replica set
+            # update, crash) — retry on a freshly-refreshed replica set.
+            # Read through .ref, not .result(): the proxy is the one caller
+            # that consumes the internal stream marker itself.
             last = None
             for _attempt in range(3):
                 try:
-                    return handle.remote(env).result(timeout_s=60)
+                    return ray_tpu.get(handle.remote(env).ref, timeout=60)
                 except Exception as e:  # noqa: BLE001
                     last = e
                     if "ActorDied" not in str(type(e).__name__) + str(e):
@@ -145,8 +184,55 @@ class HTTPProxy:
             result = await loop.run_in_executor(self._pool, call)
         except Exception as e:  # noqa: BLE001 — surface as 500
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(result, dict) and STREAM_MARKER in result:
+            return await self._stream_response(request, result[STREAM_MARKER])
         if isinstance(result, bytes):
             return web.Response(body=result)
         if isinstance(result, str):
             return web.Response(text=result)
         return web.json_response(result, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _stream_response(self, request, info):
+        """Chunked transfer of a generator deployment's output: each chunk
+        flushes as the replica yields it, so clients read tokens while the
+        handler is still running (ray parity: http_proxy.py:395)."""
+        import ray_tpu
+        from aiohttp import web
+
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "text/plain; charset=utf-8"
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        replica = ray_tpu.get_actor(info["replica"])
+        sid = info["stream_id"]
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                items, done = await loop.run_in_executor(
+                    self._pool,
+                    lambda: ray_tpu.get(
+                        replica.next_chunks.remote(sid), timeout=60
+                    ),
+                )
+                for item in items:
+                    if isinstance(item, bytes):
+                        chunk = item
+                    elif isinstance(item, str):
+                        chunk = item.encode()
+                    else:
+                        chunk = (json.dumps(item, default=str) + "\n").encode()
+                    await resp.write(chunk)
+                if done:
+                    break
+        except Exception as e:  # noqa: BLE001 — mid-stream failure
+            # headers are gone; best we can do is terminate with a marker
+            try:
+                await resp.write(f"\n[stream error: {e}]".encode())
+            except Exception:
+                pass
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+        await resp.write_eof()
+        return resp

@@ -3,27 +3,57 @@
 Reference parity: ray python/ray/serve/_private/replica.py:447
 (RayServeReplica) — the replica counts ongoing requests (the router and
 autoscaler read this), supports reconfigure(user_config), health checks,
-and graceful drain on shutdown.
+and graceful drain on shutdown. Generator callables stream: the replica
+runs the generator and buffers chunks per stream; callers (handle /
+HTTP proxy) drain them with ``next_chunks`` (ray parity:
+_private/http_proxy.py:395 streaming responses over ObjectRefGenerator —
+here a pull protocol over actor calls, which keeps chunk delivery ordered
+and backpressured without generator actor tasks).
 """
 
 from __future__ import annotations
 
 import asyncio
 import inspect
+import itertools
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+STREAM_MARKER = "__serve_stream__"
+
+# Cap buffered chunks per stream: a producer far ahead of a slow consumer
+# must block (backpressure), not buffer the whole response.
+_STREAM_BUFFER = 64
+
+# A stream untouched this long (consumer gone without cancel_stream — e.g.
+# its process died) is reaped so its producer stops and the ongoing count
+# and pool thread are released.
+_STREAM_TTL_S = 120.0
+
+
+class _Stream:
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=_STREAM_BUFFER)
+        self.done = False
+        self.done_event = asyncio.Event()
+        self.cancelled = False
+        self.error: Optional[str] = None
+        self.last_touch = time.time()
 
 
 class Replica:
     def __init__(self, serialized_init: bytes, deployment: str, app: str,
                  user_config: Optional[Any] = None,
-                 max_ongoing_requests: int = 100):
+                 max_ongoing_requests: int = 100,
+                 replica_name: Optional[str] = None):
         import cloudpickle
         import concurrent.futures
 
         cls_or_fn, init_args, init_kwargs = cloudpickle.loads(serialized_init)
         self._deployment = deployment
         self._app = app
+        self._name = replica_name
         self._ongoing = 0
         self._total = 0
         # sync user callables run here so concurrent requests don't
@@ -32,6 +62,9 @@ class Replica:
             max_workers=min(max_ongoing_requests, 32),
             thread_name_prefix="serve-replica",
         )
+        self._streams: Dict[int, _Stream] = {}
+        self._stream_ids = itertools.count()
+        self._streams_lock = threading.Lock()
         if inspect.isclass(cls_or_fn):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_function = False
@@ -65,17 +98,26 @@ class Replica:
         return True
 
     # -- data plane -----------------------------------------------------
+    def _target(self, method_name: str):
+        if self._is_function:
+            return self._callable
+        if method_name in ("__call__", None):
+            return self._callable
+        return getattr(self._callable, method_name)
+
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict):
+        self._reap_stale_streams()
         self._ongoing += 1
         self._total += 1
         try:
-            if self._is_function:
-                target = self._callable
-            elif method_name in ("__call__", None):
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
+            target = self._target(method_name)
+            unbound = target if self._is_function or method_name not in (
+                "__call__", None
+            ) else getattr(self._callable, "__call__", target)
+            if inspect.isasyncgenfunction(unbound) or \
+                    inspect.isgeneratorfunction(unbound):
+                return self._start_stream(target, unbound, args, kwargs)
             if inspect.iscoroutinefunction(target) or (
                 not self._is_function
                 and method_name in ("__call__", None)
@@ -93,3 +135,172 @@ class Replica:
             return out
         finally:
             self._ongoing -= 1
+
+    # -- streaming ------------------------------------------------------
+    def _start_stream(self, target, unbound, args, kwargs) -> dict:
+        """Kick off the generator; the caller drains via next_chunks.
+
+        The stream holds an "ongoing" slot until the generator finishes so
+        autoscaling sees streaming load.
+        """
+        with self._streams_lock:
+            sid = next(self._stream_ids)
+            stream = _Stream()
+            self._streams[sid] = stream
+        self._ongoing += 1
+        loop = asyncio.get_running_loop()
+
+        async def _put(item) -> bool:
+            # bounded wait + cancellation check: an abandoned stream's
+            # producer must stop, not block on a full queue forever
+            while not stream.cancelled:
+                try:
+                    await asyncio.wait_for(stream.queue.put(item), timeout=0.5)
+                    return True
+                except asyncio.TimeoutError:
+                    continue
+            return False
+
+        async def _drive_async():
+            try:
+                async for item in target(*args, **kwargs):
+                    if not await _put(item):
+                        break
+            except Exception as e:  # noqa: BLE001 — surfaced to the consumer
+                stream.error = f"{type(e).__name__}: {e}"
+            finally:
+                stream.done = True
+                stream.done_event.set()
+                self._ongoing -= 1
+
+        def _drive_sync():
+            try:
+                for item in target(*args, **kwargs):
+                    fut = asyncio.run_coroutine_threadsafe(_put(item), loop)
+                    if not fut.result():
+                        break
+            except Exception as e:  # noqa: BLE001
+                stream.error = f"{type(e).__name__}: {e}"
+            finally:
+                stream.done = True
+
+                def _finish():
+                    # on the loop thread: the += in handle_request and this
+                    # -= must not interleave mid-read-modify-write
+                    stream.done_event.set()
+                    self._ongoing -= 1
+
+                loop.call_soon_threadsafe(_finish)
+
+        if inspect.isasyncgenfunction(unbound):
+            loop.create_task(_drive_async())
+        else:
+            self._pool.submit(_drive_sync)
+        return {STREAM_MARKER: {"stream_id": sid, "replica": self._name}}
+
+    async def next_chunks(self, stream_id: int, max_items: int = 16,
+                          timeout_s: float = 30.0) -> Tuple[List[Any], bool]:
+        """Drain up to max_items buffered chunks; block for the first one.
+
+        Returns (items, done). done=True means the stream is exhausted
+        (after the returned items) and the id is released. Raises on
+        producer error after delivering the chunks that preceded it: a
+        call that collected chunks before the error returns them with
+        done=False; the follow-up call (now drained) raises.
+        """
+        self._reap_stale_streams()
+        stream = self._streams.get(stream_id)
+        if stream is None:
+            # raising (not a clean done=True) matters: a TTL-reaped stream
+            # must surface as an error, or a slow consumer would see a
+            # silently truncated response
+            raise RuntimeError(
+                f"stream {stream_id} is unknown (expired after "
+                f"{_STREAM_TTL_S:.0f}s idle, or already consumed)"
+            )
+        stream.last_touch = time.time()
+        items: List[Any] = []
+        try:
+            first = await asyncio.wait_for(
+                self._get_or_done(stream), timeout=timeout_s
+            )
+            if first is not _DONE:
+                items.append(first)
+        except asyncio.TimeoutError:
+            return [], False
+        while len(items) < max_items:
+            try:
+                items.append(stream.queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        finished = stream.done and stream.queue.empty()
+        if finished and stream.error is not None:
+            if items:
+                # deliver what the generator produced; keep the stream so
+                # the consumer's next call surfaces the error
+                return items, False
+            with self._streams_lock:
+                self._streams.pop(stream_id, None)
+            raise RuntimeError(
+                f"streaming handler failed: {stream.error}"
+            ) from None
+        if finished:
+            with self._streams_lock:
+                self._streams.pop(stream_id, None)
+        return items, finished
+
+    async def _get_or_done(self, stream: _Stream):
+        """First buffered item, or _DONE once the producer finished and the
+        queue is drained. Blocks on the queue/done-event, no spinning."""
+        while True:
+            try:
+                return stream.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            if stream.done:
+                return _DONE
+            get_task = asyncio.ensure_future(stream.queue.get())
+            done_task = asyncio.ensure_future(stream.done_event.wait())
+            try:
+                await asyncio.wait(
+                    {get_task, done_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            finally:
+                done_task.cancel()
+                if not get_task.done():
+                    get_task.cancel()
+            if get_task.done() and not get_task.cancelled():
+                return get_task.result()
+            # done fired; loop: a final item may have raced into the queue
+
+    def _reap_stale_streams(self):
+        """Cancel streams whose consumer vanished without cancel_stream
+        (client process death): the producer stops at its next put and
+        releases its thread and ongoing slot."""
+        now = time.time()
+        with self._streams_lock:
+            stale = [
+                (sid, s) for sid, s in self._streams.items()
+                if now - s.last_touch > _STREAM_TTL_S
+            ]
+            for sid, _ in stale:
+                self._streams.pop(sid, None)
+        for _, s in stale:
+            s.cancelled = True
+
+    def cancel_stream(self, stream_id: int) -> bool:
+        """Drop a stream a consumer abandoned; its producer notices the
+        cancel flag at its next put and stops."""
+        with self._streams_lock:
+            stream = self._streams.pop(stream_id, None)
+        if stream is not None:
+            stream.cancelled = True
+        return True
+
+
+class _Done:
+    pass
+
+
+_DONE = _Done()

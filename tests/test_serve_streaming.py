@@ -1,0 +1,183 @@
+"""Serve streaming + config-push tests.
+
+Analog of ray: python/ray/serve/tests/test_streaming_response.py (generator
+deployments stream chunks through the proxy before the handler finishes)
+and test_long_poll.py (config changes reach proxies/handles by push, not
+just polling).
+"""
+
+import time
+
+import pytest
+import requests
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _url(path):
+    return f"http://127.0.0.1:{serve.http_port()}{path}"
+
+
+def test_http_streaming_incremental(serve_cluster):
+    """Chunks must arrive while the handler is still sleeping between
+    yields — i.e. before the generator finishes."""
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, request: serve.Request):
+            n = int(request.query.get("n", "5"))
+            for i in range(n):
+                yield f"tok{i} "
+                time.sleep(0.25)
+
+    serve.run(Streamer.bind(), name="stream", route_prefix="/stream")
+    t0 = time.time()
+    first_chunk_at = None
+    chunks = []
+    with requests.get(_url("/stream"), params={"n": 5}, stream=True,
+                      timeout=60) as r:
+        assert r.status_code == 200
+        for chunk in r.iter_content(chunk_size=None):
+            if first_chunk_at is None:
+                first_chunk_at = time.time() - t0
+            chunks.append(chunk)
+    total = time.time() - t0
+    body = b"".join(chunks).decode()
+    assert body == "tok0 tok1 tok2 tok3 tok4 "
+    # 5 yields * 0.25s sleep = 1.25s minimum handler runtime; the first
+    # token must arrive well before the handler can have finished.
+    assert first_chunk_at is not None and first_chunk_at < total - 0.5, (
+        f"first chunk at {first_chunk_at:.2f}s of {total:.2f}s — "
+        "not streamed incrementally"
+    )
+    serve.delete("stream")
+
+
+def test_handle_streaming_generator(serve_cluster):
+    @serve.deployment
+    class Gen:
+        async def __call__(self, n: int):
+            for i in range(n):
+                yield i * i
+
+    handle = serve.run(Gen.bind(), name="gen", route_prefix="/gen")
+    gen = handle.options(stream=True).remote(6)
+    seen = []
+    t_first = None
+    t0 = time.time()
+    for item in gen:
+        if t_first is None:
+            t_first = time.time() - t0
+        seen.append(item)
+    assert seen == [0, 1, 4, 9, 16, 25]
+    serve.delete("gen")
+
+
+def test_streaming_error_delivers_prior_chunks(serve_cluster):
+    @serve.deployment
+    class Flaky:
+        def __call__(self, _n):
+            yield "a"
+            yield "b"
+            raise RuntimeError("boom mid-stream")
+
+    handle = serve.run(Flaky.bind(), name="flaky", route_prefix="/flaky")
+    gen = handle.options(stream=True).remote(0)
+    seen = []
+    with pytest.raises(Exception, match="boom mid-stream"):
+        for item in gen:
+            seen.append(item)
+    assert seen == ["a", "b"]
+    serve.delete("flaky")
+
+
+def test_route_push_beats_polling(serve_cluster):
+    """After the first request warms the proxy's route table, deploying a
+    NEW app must serve quickly — the controller pushes the route, the
+    proxy must not wait out a poll TTL or 404."""
+
+    @serve.deployment
+    def one(_request):
+        return "one"
+
+    serve.run(one.bind(), name="push1", route_prefix="/push1")
+    assert requests.get(_url("/push1"), timeout=30).text == "one"
+
+    @serve.deployment
+    def two(_request):
+        return "two"
+
+    serve.run(two.bind(), name="push2", route_prefix="/push2")
+    t0 = time.time()
+    r = requests.get(_url("/push2"), timeout=30)
+    assert r.status_code == 200 and r.text == "two"
+    assert time.time() - t0 < 5.0
+    serve.delete("push1")
+    serve.delete("push2")
+
+
+def test_p2c_uses_reported_queue_lens(serve_cluster):
+    """A FRESH handle (no local in-flight history) must steer away from a
+    replica the controller reports as loaded."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class Who:
+        def __call__(self, block_s: float = 0.0):
+            if block_s:
+                time.sleep(block_s)
+            import os
+
+            return os.getpid()
+
+    serve.run(Who.bind(), name="p2c", route_prefix="/p2c")
+    # occupy ONE replica with slow calls sent directly to its actor (a
+    # handle would p2c-balance them — the point is to create the skew an
+    # independent caller produces, which fresh handles can only see via
+    # controller-reported loads)
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    names = ray_tpu.get(
+        controller.get_replica_names.remote("p2c", "Who"), timeout=30
+    )
+    assert len(names) == 2
+    busy_actor = ray_tpu.get_actor(names[0])
+    busy = [
+        busy_actor.handle_request.remote("__call__", (8.0,), {})
+        for _ in range(4)
+    ]
+    time.sleep(0.1)
+    # wait for the controller's load collector to observe the imbalance
+    deadline = time.time() + 15
+    loads = {}
+    while time.time() < deadline:
+        state = ray_tpu.get(
+            controller.get_replica_state.remote("p2c", "Who"), timeout=10
+        )
+        loads = state["loads"]
+        if loads.get(names[0], 0) >= 3 and loads.get(names[1], 1) == 0:
+            break
+        time.sleep(0.25)
+    assert loads.get(names[0], 0) >= 3, f"loads never observed: {loads}"
+    # a brand-new handle has zero local knowledge; with reported loads it
+    # must route fast calls to the idle replica
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h2 = DeploymentHandle("Who", "p2c")
+    t0 = time.time()
+    pids = {h2.remote(0.0).result(timeout_s=30) for _ in range(6)}
+    fast_elapsed = time.time() - t0
+    assert fast_elapsed < 4.0, (
+        f"fresh handle routed into the busy replica ({fast_elapsed:.1f}s)"
+    )
+    assert len(pids) == 1  # all steered to the one idle replica
+    ray_tpu.get(busy, timeout=60)
+    serve.delete("p2c")
