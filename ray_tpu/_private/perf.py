@@ -104,6 +104,35 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         ray_tpu.kill(a)
         return out
 
+    @bench("actor_calls_async_n_n", "n:n actor calls async")
+    def _actor_nn():
+        # 4 actors fed concurrently from this client (ray_perf's n:n shape
+        # with the caller side folded into one submitting process)
+        actors = [Sink.remote() for _ in range(4)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+
+        def run():
+            refs = []
+            for a in actors:
+                refs.extend(a.ping.remote() for _ in range(batch // 4))
+            ray_tpu.get(refs)
+            return (batch // 4) * 4
+        out = _timeit("n:n actor calls async", run)
+        for a in actors:
+            ray_tpu.kill(a)
+        return out
+
+    @bench("get_10k_refs", "get 10k small refs")
+    def _get_10k():
+        n = 1000 if small else 10000
+        refs = [ray_tpu.put(b"x" * 100) for _ in range(n)]
+
+        def run():
+            got = ray_tpu.get(refs)
+            assert len(got) == n
+            return n
+        return _timeit("get 10k small refs", run)
+
     @bench("put_small", "small put (100B)")
     def _put_small():
         def run():
