@@ -6,6 +6,19 @@ chips and ICI topology as first-class resources; the device plane is JAX/XLA
 (pjit/shard_map over meshes, Pallas kernels) instead of CUDA/NCCL.
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor an explicit JAX_PLATFORMS in DRIVER processes too: the env var
+    # alone cannot un-register a plugin backend a sitecustomize installed
+    # at interpreter start (e.g. the axon TPU tunnel), and a dead tunnel
+    # hangs the first jnp dispatch. Workers get the same pin in
+    # worker_main; this covers scripts that set the env then import
+    # ray_tpu before (or instead of) touching jax directly.
+    from ray_tpu._private.jax_pin import _pin_jax_platform_on_import
+
+    _pin_jax_platform_on_import(_os.environ["JAX_PLATFORMS"])
+
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import TaskError
 from ray_tpu._private.worker import (

@@ -111,6 +111,8 @@ _flag("memory_monitor_test_path", str, "")  # test injection: file with a float
 _flag("metrics_report_interval_s", float, 2.0)
 _flag("task_events_buffer_size", int, 10_000)
 _flag("event_stats", bool, True)
+# Worker-log streaming to drivers (ray: log_monitor.py tail cadence)
+_flag("log_tail_interval_s", float, 0.3)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)

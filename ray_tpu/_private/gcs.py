@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set
 
 from ray_tpu._private.common import NodeInfo, TaskSpec, place_bundles, res_fits
@@ -150,6 +151,9 @@ class GcsServer:
         self._started = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
         self.task_events: List[dict] = []  # bounded task-event log for state API
+        # structured cluster events (ray parity: src/ray/util/event.h:130 —
+        # severity/source/label/message + custom fields), bounded ring
+        self.events: deque = deque(maxlen=10_000)
         self._store = make_store(persist_path)
         self._recovering: Set[bytes] = set()  # actor_ids awaiting raylet reclaim
         self._recovered = self._replay()
@@ -299,6 +303,11 @@ class GcsServer:
         if state:
             await self._reconcile_node_state(node.node_id, state)
         await self._publish("node", {"event": "alive", "node": info})
+        self._record_event(
+            "INFO", "gcs", "NODE_ADDED",
+            f"node {node.node_id[:12]} joined at {node.host}:{node.port}",
+            {"node_id": node.node_id},
+        )
         await self._broadcast_view()
         return {"node_id": node.node_id, "nodes": self._view()}
 
@@ -332,6 +341,8 @@ class GcsServer:
             return {"reregister": True}
         node.last_heartbeat = time.monotonic()
         node.resources_available = payload["resources_available"]
+        if "resources_total" in payload:
+            node.resources_total = payload["resources_total"]
         node.pending_demand = payload.get("pending_demand", [])
         idle = payload.get("idle", False)
         if idle and not node.idle:
@@ -406,6 +417,11 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        self._record_event(
+            "WARNING", "gcs", "NODE_DEAD",
+            f"node {node_id[:12]} marked dead: {reason}",
+            {"node_id": node_id, "reason": reason},
+        )
         await self._publish("node", {"event": "dead", "node_id": node_id, "reason": reason})
         # Restart or fail actors that lived there.
         for rec in list(self.actors.values()):
@@ -486,6 +502,40 @@ class GcsServer:
     # ------------------------------------------------------------------
     # Pubsub (ray: src/ray/pubsub/)
     # ------------------------------------------------------------------
+    # -- structured events (ray parity: util/event.h + event aggregator) --
+    def _record_event(self, severity: str, source: str, label: str,
+                      message: str, fields: Optional[dict] = None):
+        self.events.append({
+            "timestamp": time.time(),
+            "severity": severity,
+            "source": source,
+            "label": label,
+            "message": message,
+            "fields": fields or {},
+        })
+
+    async def rpc_add_event(self, conn: Connection, p):
+        self._record_event(
+            p.get("severity", "INFO"), p.get("source", "user"),
+            p.get("label", ""), p.get("message", ""), p.get("fields"),
+        )
+        return {}
+
+    async def rpc_get_events(self, conn: Connection, p):
+        severity = p.get("severity")
+        source = p.get("source")
+        limit = p.get("limit") or 100
+        out = []
+        for ev in reversed(self.events):  # newest first
+            if severity and ev["severity"] != severity:
+                continue
+            if source and ev["source"] != source:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
     async def rpc_subscribe(self, conn: Connection, p):
         self.subscribers.setdefault(p["channel"], set()).add(conn)
         return {}
@@ -691,7 +741,15 @@ class GcsServer:
 
     async def _handle_actor_failure(self, rec: ActorRecord, reason: str):
         max_restarts = rec.spec.max_restarts
-        if max_restarts == -1 or rec.num_restarts < max_restarts:
+        will_restart = max_restarts == -1 or rec.num_restarts < max_restarts
+        self._record_event(
+            "WARNING" if will_restart else "ERROR", "gcs",
+            "ACTOR_RESTARTING" if will_restart else "ACTOR_DEAD",
+            f"actor {rec.actor_id.hex()[:12]} ({rec.spec.name}) failed: "
+            f"{reason}" + (" — restarting" if will_restart else ""),
+            {"actor_id": rec.actor_id.hex(), "reason": reason},
+        )
+        if will_restart:
             rec.num_restarts += 1
             rec.state = RESTARTING
             rec.node_id = None

@@ -60,7 +60,7 @@ def runtime_env_hash(runtime_env: Optional[dict]) -> str:
 
 class _Worker:
     def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes],
-                 env_hash: str = ""):
+                 env_hash: str = "", log_path: Optional[str] = None):
         self.proc = proc
         self.job_id = job_id
         self.env_hash = env_hash
@@ -71,6 +71,11 @@ class _Worker:
         self.registered = asyncio.get_running_loop().create_future()
         self.started_at = time.monotonic()
         self.oom_killed = False
+        # log streaming (ray: _private/log_monitor.py): the raylet tails
+        # this file and publishes new lines to drivers
+        self.log_path = log_path
+        self.log_offset = 0
+        self.log_partial = b""
 
 
 # Pull priorities (ray: pull_manager.h:31-38 BundlePriority — Get before
@@ -237,8 +242,71 @@ class Raylet:
         self._tasks.append(
             asyncio.get_running_loop().create_task(self._infeasible_retry_loop())
         )
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(self._log_tailer_loop())
+        )
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
+
+    # ------------------------------------------------------------------
+    # worker-log streaming (ray: _private/log_monitor.py — the per-node
+    # monitor tails worker log files and publishes lines so connected
+    # drivers can print them)
+    # ------------------------------------------------------------------
+    def _tail_worker_log(self, w: _Worker, final: bool = False):
+        """Read newly appended bytes of one worker's log; returns a batch
+        entry or None. ``final`` drains to EOF and flushes the partial
+        line (worker exiting — its last write IS the traceback)."""
+        if not w.log_path:
+            return None
+        lines_out = []
+        try:
+            with open(w.log_path, "rb") as f:
+                f.seek(w.log_offset)
+                while True:
+                    chunk = f.read(65536)
+                    if not chunk:
+                        break
+                    w.log_offset += len(chunk)
+                    data = w.log_partial + chunk
+                    *lines, w.log_partial = data.split(b"\n")
+                    lines_out.extend(lines)
+                    if not final:
+                        break  # bounded per tick; the next tick continues
+        except OSError:
+            return None
+        if final and w.log_partial:
+            lines_out.append(w.log_partial)
+            w.log_partial = b""
+        text = [ln.decode("utf-8", "replace") for ln in lines_out if ln]
+        if not text:
+            return None
+        return {
+            "pid": w.proc.pid,
+            "job_id": w.job_id.hex() if w.job_id else None,
+            "lines": text,
+        }
+
+    async def _publish_worker_logs(self, batch):
+        if not batch:
+            return
+        try:
+            await self.gcs.request("publish", {
+                "channel": "worker_log",
+                "message": {"node_id": self.node_id, "workers": batch},
+            })
+        except Exception:
+            pass
+
+    async def _log_tailer_loop(self):
+        while True:
+            await asyncio.sleep(cfg.log_tail_interval_s)
+            batch = []
+            for w in list(self.all_workers.values()):
+                entry = self._tail_worker_log(w)
+                if entry:
+                    batch.append(entry)
+            await self._publish_worker_logs(batch)
 
     # ------------------------------------------------------------------
     # task events (observability; ray: task_event_buffer.h:199)
@@ -339,6 +407,20 @@ class Raylet:
                 self.counters.get("workers_oom_killed", 0) + 1
             )
             try:
+                await self.gcs.request("add_event", {
+                    "severity": "WARNING", "source": "raylet",
+                    "label": "WORKER_OOM_KILLED",
+                    "message": (
+                        f"memory usage {usage:.2f} over threshold "
+                        f"{cfg.memory_usage_threshold:.2f}: killed worker "
+                        f"pid={victim.proc.pid}"
+                    ),
+                    "fields": {"node_id": self.node_id,
+                               "pid": victim.proc.pid},
+                })
+            except Exception:
+                pass
+            try:
                 victim.proc.kill()
             except Exception:
                 pass
@@ -438,6 +520,11 @@ class Raylet:
                     {
                         "node_id": self.node_id,
                         "resources_available": dict(self.resources_available),
+                        # totals change at runtime (PG prepare adds named
+                        # bundle resources); without this, other raylets
+                        # judge _pg_* demand infeasible cluster-wide and
+                        # bundle-scheduled work parks forever
+                        "resources_total": dict(self.resources_total),
                         "pending_demand": self._pending_demand(),
                         "idle": self._is_idle(),
                     },
@@ -562,6 +649,15 @@ class Raylet:
         if w is None:
             return
         self.all_workers.pop(w.proc.pid, None)
+        # final log drain: the crash traceback lands in the file right as
+        # the process exits, after the tailer's last tick — deliver it
+        entry = self._tail_worker_log(w, final=True)
+        if entry:
+            t = asyncio.get_running_loop().create_task(
+                self._publish_worker_logs([entry])
+            )
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
         pool = self.idle_workers.get(w.env_hash)
         if pool is not None:
             try:
@@ -987,15 +1083,21 @@ class Raylet:
         # Workers must not grab the TPU unless a task asks for it; JAX inits
         # lazily so this is safe, but keep workers on CPU by default for
         # control-plane work (the trainer backend overrides per worker group).
-        log_path = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_path, exist_ok=True)
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        self._worker_seq = getattr(self, "_worker_seq", 0) + 1
+        log_file = os.path.join(
+            log_dir,
+            f"worker-{self.node_id[:8]}-{self._worker_seq}.out",
+        )
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env,
-            stdout=open(os.path.join(log_path, f"worker-{time.time():.0f}-{os.getpid()}.out"), "ab"),
+            stdout=open(log_file, "ab"),
             stderr=subprocess.STDOUT,
         )
-        w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env))
+        w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env),
+                    log_path=log_file)
         self.all_workers[proc.pid] = w
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)

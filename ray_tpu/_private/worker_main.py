@@ -8,61 +8,7 @@ import logging
 import os
 import threading
 
-
-def _pin_jax_platform_on_import(platforms: str):
-    """Arrange for jax.config.update("jax_platforms", ...) to run right
-    after jax finishes importing — wherever that import happens. If jax is
-    already in (e.g. a sitecustomize imported it at interpreter start),
-    pin immediately."""
-    import sys
-
-    if "jax" in sys.modules:
-        try:
-            sys.modules["jax"].config.update("jax_platforms", platforms)
-        except Exception:
-            pass
-        return
-
-    import importlib.abc
-    import importlib.util
-
-    class _Finder(importlib.abc.MetaPathFinder):
-        def __init__(self):
-            self._busy = False
-
-        def find_spec(self, name, path=None, target=None):
-            if name != "jax" or self._busy:
-                return None
-            self._busy = True  # find_spec below re-enters the meta path
-            try:
-                spec = importlib.util.find_spec("jax")
-            finally:
-                self._busy = False
-            if spec is None or spec.loader is None:
-                return None
-            orig_loader = spec.loader
-            finder = self
-
-            class _Loader(importlib.abc.Loader):
-                def create_module(self, spec):
-                    return orig_loader.create_module(spec)
-
-                def exec_module(self, module):
-                    orig_loader.exec_module(module)
-                    # one-shot: jax is pinned; stop intercepting imports
-                    try:
-                        sys.meta_path.remove(finder)
-                    except ValueError:
-                        pass
-                    try:
-                        module.config.update("jax_platforms", platforms)
-                    except Exception:
-                        pass
-
-            spec.loader = _Loader()
-            return spec
-
-    sys.meta_path.insert(0, _Finder())
+from ray_tpu._private.jax_pin import _pin_jax_platform_on_import
 
 
 def main():

@@ -126,7 +126,35 @@ def init(
         )
         global_worker.core_worker = cw
         global_worker.mode = "driver"
+        if log_to_driver:
+            _subscribe_worker_logs(cw)
         return RayContext(address, cw.node_id)
+
+
+def _subscribe_worker_logs(cw):
+    """Print worker stdout/stderr on the driver (ray parity:
+    _private/log_monitor.py + worker.py print_logs — lines arrive over
+    GCS pubsub from each raylet's log tailer; entries are tagged with the
+    worker's job so concurrent drivers only see their own job's output)."""
+    import sys
+
+    my_job = cw.job_id.hex() if cw.job_id else None
+
+    def on_logs(msg):
+        node = (msg.get("node_id") or "")[:8]
+        for entry in msg.get("workers", ()):
+            job = entry.get("job_id")
+            if job is not None and my_job is not None and job != my_job:
+                continue
+            pid = entry.get("pid")
+            for line in entry.get("lines", ()):
+                print(f"\x1b[36m(pid={pid}, node={node})\x1b[0m {line}",
+                      file=sys.stderr)
+
+    try:
+        cw.subscribe("worker_log", on_logs)
+    except Exception:
+        pass  # logs stay in session files
 
 
 def shutdown():

@@ -79,6 +79,36 @@ def _build_app():
         )
         return _json_response(out)
 
+    @routes.get("/metrics")
+    async def prometheus_metrics(request):
+        """Prometheus text exposition: user metrics + cluster built-ins
+        (ray parity: the per-node metrics agent's scrape endpoint)."""
+        from ray_tpu.dashboard.prometheus import (
+            cluster_builtin_metrics,
+            render_metrics,
+        )
+        from ray_tpu.util import metrics as m
+
+        def build():
+            records = dict(m.list_metrics())
+            records.update(cluster_builtin_metrics())
+            return render_metrics(records)
+
+        text = await asyncio.get_running_loop().run_in_executor(None, build)
+        return web.Response(
+            text=text, content_type="text/plain", charset="utf-8"
+        )
+
+    @routes.get("/api/v0/events")
+    async def events(request):
+        from ray_tpu.util import events as ev
+
+        limit = request.query.get("limit")
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: ev.list_events(limit=int(limit) if limit else 100)
+        )
+        return _json_response(out)
+
     @routes.get("/api/v0/cluster_resources")
     async def cluster_resources(request):
         import ray_tpu

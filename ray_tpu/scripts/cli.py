@@ -133,6 +133,40 @@ def cmd_status(args):
         print(f"  {mark} {n['node_id'][:12]} {n['host']}:{n['port']} "
               f"{n['resources_total']}")
     print(f"resources: {avail} available of {total}")
+    # task-event counts (ray parity: `ray summary tasks` folded into status)
+    try:
+        from ray_tpu.util import state
+
+        summary = state.summarize_tasks()
+        if summary:
+            totals = {}
+            for entry in summary.values():
+                for k, v in entry.items():
+                    totals[k] = totals.get(k, 0) + v
+            print("tasks: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(totals.items()) if k != "total"
+            ) + f" (total={totals.get('total', 0)})")
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def cmd_events(args):
+    import ray_tpu
+    from ray_tpu.util import events as ev
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    rows = ev.list_events(severity=args.severity or None,
+                          source=args.source or None,
+                          limit=args.limit)
+    import datetime
+
+    for e in reversed(rows):  # oldest first for reading
+        ts = datetime.datetime.fromtimestamp(e["timestamp"]).strftime(
+            "%H:%M:%S"
+        )
+        print(f"{ts} [{e['severity']:<7s}] {e['source']}/{e['label']}: "
+              f"{e['message']}")
     ray_tpu.shutdown()
 
 
@@ -335,6 +369,13 @@ def main(argv=None):
     jp.add_argument("submission_id")
     jp.add_argument("--address")
     jp.set_defaults(fn=cmd_job_stop)
+
+    p = sub.add_parser("events", help="show structured cluster events")
+    p.add_argument("--address")
+    p.add_argument("--severity", help="filter: DEBUG/INFO/WARNING/ERROR/FATAL")
+    p.add_argument("--source", help="filter: gcs/raylet/user/...")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("timeline", help="dump chrome trace of task events")
     p.add_argument("--address")
