@@ -45,6 +45,12 @@ class AlgorithmConfig:
         self.env_config: Dict[str, Any] = {}
         self.num_env_runners = 2
         self.rollout_fragment_length = 200
+        # >=1: that many learner ACTORS with DDP gradient sync
+        # (LearnerGroup); 0 = one in-driver learner (ray parity:
+        # config.learners(num_learners=...))
+        self.num_learners = 0
+        self.num_cpus_per_learner = 0.5
+        self.num_tpus_per_learner = 0  # >0: learner actors claim chips
         self.lr = 5e-3
         self.gamma = 0.99
         self.lambda_ = 0.95
@@ -81,6 +87,20 @@ class AlgorithmConfig:
 
     # accepted for reference-API compatibility
     rollouts = env_runners
+
+    def learners(self, *, num_learners=None, num_cpus_per_learner=None,
+                 num_tpus_per_learner=None, num_gpus_per_learner=None,
+                 **_kw):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_cpus_per_learner is not None:
+            self.num_cpus_per_learner = num_cpus_per_learner
+        # accept the reference's GPU spelling as the chip knob
+        chips = num_tpus_per_learner if num_tpus_per_learner is not None \
+            else num_gpus_per_learner
+        if chips is not None:
+            self.num_tpus_per_learner = chips
+        return self
 
     def training(self, **kwargs):
         for k, v in kwargs.items():
@@ -150,11 +170,37 @@ class Algorithm(Trainable):
         obs_shape, num_actions = env_spaces(probe)
         if hasattr(probe, "close"):
             probe.close()
+        hiddens = tuple(cfg.model.get("hiddens", (64, 64)))
         self.module = RLModule(
-            obs_shape, num_actions, seed=cfg.seed,
-            hiddens=tuple(cfg.model.get("hiddens", (64, 64))),
+            obs_shape, num_actions, seed=cfg.seed, hiddens=hiddens,
         )
-        self.learner = self._learner_cls(self.module, cfg)
+        if getattr(cfg, "num_learners", 0) >= 1:
+            # Multi-learner plane: N learner actors, DDP gradient sync.
+            # Each worker rebuilds an identical module (same seed) so the
+            # replicas start in sync; the driver's module mirrors rank-0
+            # weights at every _sync_weights for local inference.
+            if not getattr(self._learner_cls, "supports_ddp", False):
+                raise ValueError(
+                    f"num_learners={cfg.num_learners} is not supported for "
+                    f"{self._learner_cls.__name__}: only learners with the "
+                    "split grad/apply step (PPO, IMPALA, APPO) can run "
+                    "under LearnerGroup; use num_learners=0"
+                )
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            seed, model_hiddens = cfg.seed, hiddens
+
+            def module_factory(_shape=obs_shape, _n=num_actions):
+                return RLModule(_shape, _n, seed=seed, hiddens=model_hiddens)
+
+            self.learner = LearnerGroup(
+                self._learner_cls, module_factory, cfg,
+                num_learners=cfg.num_learners,
+                num_cpus_per_learner=getattr(cfg, "num_cpus_per_learner", 0.5),
+                num_tpus_per_learner=getattr(cfg, "num_tpus_per_learner", 0),
+            )
+        else:
+            self.learner = self._learner_cls(self.module, cfg)
         # Sampling plane runs on host CPUs: the learner owns the TPU chips
         # (libtpu is single-client per host), so runner processes pin JAX
         # to the CPU backend.
@@ -265,7 +311,14 @@ class Algorithm(Trainable):
         raise last
 
     def _sync_weights(self):
-        weights = ray_tpu.put(self.learner.get_weights())
+        raw = self.learner.get_weights()
+        from ray_tpu.rllib.learner_group import LearnerGroup
+
+        if isinstance(self.learner, LearnerGroup):
+            # keep the driver's module current for compute_single_action /
+            # evaluate (with an in-driver learner they share params)
+            self.module.set_state(raw)
+        weights = ray_tpu.put(raw)
         self._with_runner_ft(lambda: ray_tpu.get(
             [r.set_weights.remote(weights) for r in self.runners]
         ))
@@ -312,6 +365,12 @@ class Algorithm(Trainable):
         for r in getattr(self, "runners", []):
             try:
                 ray_tpu.kill(r)
+            except Exception:
+                pass
+        learner = getattr(self, "learner", None)
+        if learner is not None and hasattr(learner, "shutdown"):
+            try:
+                learner.shutdown()
             except Exception:
                 pass
 
@@ -450,6 +509,14 @@ class TD3(Algorithm):
         from ray_tpu.rllib.rl_module import ContinuousRLModule
 
         cfg = self._algo_config
+        if getattr(cfg, "num_learners", 0) >= 1:
+            # this setup builds its own single in-driver learner; silently
+            # ignoring the option would fake a multi-learner run
+            raise ValueError(
+                "num_learners>=1 is not supported for TD3/DDPG "
+                "(twin-optimizer learner has no DDP split); use "
+                "num_learners=0"
+            )
         probe = make_env(cfg.env, cfg.env_config)
         try:
             obs_shape = env_obs_shape(probe)

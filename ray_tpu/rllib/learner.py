@@ -29,6 +29,10 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 class Learner:
+    # True for learners whose step is built by _build_train_step (split
+    # grad/apply halves exist) — only these can run under LearnerGroup.
+    supports_ddp = False
+
     def __init__(self, module: RLModule, config):
         self.module = module
         self.config = config
@@ -41,9 +45,13 @@ class Learner:
     def get_weights(self):
         return self.module.get_state()
 
-    def sgd_epochs(self, batch: "SampleBatch", keys=None) -> Dict[str, float]:
+    def sgd_epochs(self, batch: "SampleBatch", keys=None,
+                   step_fn=None) -> Dict[str, float]:
         """Shared minibatch-SGD driver: shuffle + minibatch + jitted
-        train_step for config.num_epochs (used by PPO and BC)."""
+        train_step for config.num_epochs (used by PPO and BC). ``step_fn``
+        overrides the per-minibatch step (jmb -> metrics dict), which is
+        how the DDP path injects its grad/allreduce/apply split without
+        duplicating this loop."""
         cfg = self.config
         rng = getattr(self, "_rng", None)
         if rng is None:
@@ -56,9 +64,12 @@ class Learner:
                     continue
                 jmb = {k: jnp.asarray(v) for k, v in mb.items()
                        if keys is None or k in keys}
-                self.module.params, self.opt_state, metrics = (
-                    self._train_step(self.module.params, self.opt_state, jmb)
-                )
+                if step_fn is not None:
+                    metrics = step_fn(jmb)
+                else:
+                    self.module.params, self.opt_state, metrics = (
+                        self._train_step(self.module.params, self.opt_state, jmb)
+                    )
         return {k: float(v) for k, v in metrics.items()}
 
     def set_weights(self, params):
@@ -83,7 +94,9 @@ class Learner:
     # -- shared machinery for actor-critic learners ---------------------
     def _build_train_step(self, loss_fn):
         """jit the standard (loss, aux) -> optimizer step; aux must be the
-        (pi_loss, vf_loss, entropy) triple."""
+        (pi_loss, vf_loss, entropy) triple. Also builds the split
+        grad/apply pair the DDP LearnerGroup uses (gradients cross the
+        process boundary between the two halves)."""
 
         def train_step(params, opt_state, mb):
             (total, (pi, vf, ent)), grads = jax.value_and_grad(
@@ -96,7 +109,38 @@ class Learner:
                 "vf_loss": vf, "entropy": ent,
             }
 
+        def grad_step(params, mb):
+            (total, (pi, vf, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, mb)
+            return grads, {
+                "total_loss": total, "policy_loss": pi,
+                "vf_loss": vf, "entropy": ent,
+            }
+
+        def apply_step(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grad_step = jax.jit(grad_step)
+        self._apply_step = jax.jit(apply_step)
         return jax.jit(train_step)
+
+    # -- DDP hooks (LearnerGroup) ---------------------------------------
+    def update_ddp(self, batch: "SampleBatch", allreduce) -> Dict[str, float]:
+        """One data-parallel update: local grads on this learner's shard,
+        ``allreduce`` (a pytree -> pytree mean across the group), then the
+        optimizer step — every learner applies identical averaged grads to
+        identical params, so replicas stay in sync without a broadcast
+        (ray parity: learner.py:558 postprocess_gradients + DDP wrap).
+        Default = single full-batch step (IMPALA/APPO shape)."""
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
+        grads, metrics = self._grad_step(self.module.params, jmb)
+        grads = allreduce(grads)
+        self.module.params, self.opt_state = self._apply_step(
+            self.module.params, self.opt_state, grads
+        )
+        return {k: float(v) for k, v in metrics.items()}
 
     def _update_full_batch(self, batch: SampleBatch) -> Dict[str, float]:
         """One jitted step over the whole (time-ordered) batch."""
@@ -108,6 +152,8 @@ class Learner:
 
 
 class PPOLearner(Learner):
+    supports_ddp = True
+
     def __init__(self, module: RLModule, config):
         super().__init__(module, config)
         net = module.net
@@ -139,6 +185,23 @@ class PPOLearner(Learner):
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         return self.sgd_epochs(batch)
+
+    def update_ddp(self, batch: SampleBatch, allreduce) -> Dict[str, float]:
+        """PPO's epoch/minibatch SGD with an allreduce between grad and
+        apply — the shared sgd_epochs driver with a DDP step injected.
+        Every group member runs the SAME number of minibatches (equal
+        shard sizes, fixed minibatch grid) — a mismatch would deadlock
+        the lockstep allreduces."""
+
+        def ddp_step(jmb):
+            grads, metrics = self._grad_step(self.module.params, jmb)
+            grads = allreduce(grads)
+            self.module.params, self.opt_state = self._apply_step(
+                self.module.params, self.opt_state, grads
+            )
+            return metrics
+
+        return self.sgd_epochs(batch, step_fn=ddp_step)
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, next_values, dones,
@@ -192,6 +255,8 @@ def _vtrace_forward(net, gamma, params, mb):
 
 
 class ImpalaLearner(Learner):
+    supports_ddp = True
+
     def __init__(self, module: RLModule, config):
         super().__init__(module, config)
         net = module.net
@@ -220,6 +285,8 @@ class APPOLearner(Learner):
     (ray parity: rllib/algorithms/appo — IMPALA's off-policy correction
     with PPO's trust region, so stale fragments can be re-used for
     multiple SGD passes without policy collapse)."""
+
+    supports_ddp = True
 
     def __init__(self, module: RLModule, config):
         super().__init__(module, config)

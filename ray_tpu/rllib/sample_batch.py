@@ -50,6 +50,22 @@ class SampleBatch(dict):
                 {k: v[start : start + size] for k, v in self.items()}
             )
 
+    def shards(self, n: int) -> List["SampleBatch"]:
+        """Split into n EQUAL-size shards (remainder dropped): DDP learners
+        must run identical minibatch counts or their lockstep gradient
+        allreduces deadlock (ray parity: learner_group.py batch sharding)."""
+        per = self.count // n
+        if per == 0:
+            raise ValueError(
+                f"batch of {self.count} rows cannot shard {n} ways"
+            )
+        return [
+            SampleBatch({
+                k: v[i * per:(i + 1) * per] for k, v in self.items()
+            })
+            for i in range(n)
+        ]
+
 
 def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
                 lam: float) -> SampleBatch:
