@@ -1,0 +1,66 @@
+"""Broadcast throughput bench: N MB to K nodes over the push-plane tree.
+
+ray parity target: release/benchmarks/README.md:17-19 (broadcast 1 GiB to
+50 nodes). Here: a local multi-raylet cluster (separate processes +
+separate shm stores) measures the tree fan-out against a naive
+one-by-one flat push.
+
+Usage: python benches/broadcast_bench.py [--mb 256] [--nodes 4]
+"""
+
+import argparse
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--nodes", type=int, default=4)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.transfer import broadcast_object, push_object
+
+    cluster = Cluster(initialize_head=False)
+    for _ in range(args.nodes):
+        cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        payload = os.urandom(args.mb * 1024 * 1024)
+        nodes = [n["node_id"] for n in ray_tpu.nodes() if n["alive"]]
+        me = ray_tpu.get_runtime_context().get_node_id()
+        targets = [n for n in nodes if n != me]
+
+        ref = ray_tpu.put(payload)
+        t0 = time.perf_counter()
+        broadcast_object(ref, nodes)
+        tree_s = time.perf_counter() - t0
+
+        ref2 = ray_tpu.put(payload)
+        t0 = time.perf_counter()
+        push_object(ref2, targets)
+        flat_s = time.perf_counter() - t0
+
+        out = {
+            "bench": "broadcast",
+            "mb": args.mb,
+            "targets": len(targets),
+            "tree_s": round(tree_s, 3),
+            "flat_s": round(flat_s, 3),
+            "tree_aggregate_MBps": round(args.mb * len(targets) / tree_s, 1),
+            "flat_aggregate_MBps": round(args.mb * len(targets) / flat_s, 1),
+        }
+        print(json.dumps(out))
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,8 @@ _flag("task_events_buffer_size", int, 10_000)
 _flag("event_stats", bool, True)
 # Worker-log streaming to drivers (ray: log_monitor.py tail cadence)
 _flag("log_tail_interval_s", float, 0.3)
+# Push plane (ray: push_manager.h max_chunks_in_flight per push)
+_flag("push_max_chunks_in_flight", int, 8)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)

@@ -194,6 +194,11 @@ class Raylet:
         self._actor_route_queues: Dict[bytes, deque] = {}
         self._actor_routers: set = set()
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # push plane (ray: push_manager.h): (oid, node) dedup + per-peer
+        # chunk pipelines + receiver-side assembly buffers
+        self._pushes_inflight: Dict[tuple, asyncio.Future] = {}
+        self._push_peer_sems: Dict[str, asyncio.Semaphore] = {}
+        self._push_rx: Dict[bytes, dict] = {}
         self._pull_gate = _PullGate(
             cfg.max_concurrent_pulls,
             int(cfg.object_store_memory * cfg.pull_manager_memory_fraction),
@@ -1250,10 +1255,13 @@ class Raylet:
                 ok = await self._do_pull(oid, timeout=timeout)
             finally:
                 self._pull_gate.release_slot()
-            fut.set_result(ok)
-            return ok
+            # an incoming push may have satisfied (and resolved) us already
+            if not fut.done():
+                fut.set_result(ok)
+            return fut.result()
         except Exception as e:
-            fut.set_result(False)
+            if not fut.done():
+                fut.set_result(False)
             logger.warning("pull of %s failed: %s", oid_bytes.hex()[:16], e)
             return False
         finally:
@@ -1328,6 +1336,204 @@ class Raylet:
             return True
         finally:
             self._pull_gate.uncharge(total)
+
+    # ------------------------------------------------------------------
+    # push plane (ray: object_manager/push_manager.h:30 — owner/holder-
+    # initiated transfer with per-peer chunk budgets and dedup, vs the
+    # receiver-driven pull path above) + tree broadcast
+    # ------------------------------------------------------------------
+    async def push_object(self, oid: ObjectID, node_id: str) -> bool:
+        """Push a locally-present object to one peer. Dedup: a second push
+        of the same (object, peer) while one is in flight piggybacks on
+        it; chunk sends share a bounded per-peer pipeline."""
+        key = (oid.binary(), node_id)
+        existing = self._pushes_inflight.get(key)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._pushes_inflight[key] = fut
+        ok = False
+        try:
+            ok = await self._do_push(oid, node_id)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("push of %s to %s failed: %s",
+                           oid.hex()[:16], node_id[:8], e)
+        finally:
+            # resolve in the finally: if this task is CANCELLED mid-push,
+            # piggybacked pushers shielded on `fut` must not hang forever
+            self._pushes_inflight.pop(key, None)
+            if not fut.done():
+                fut.set_result(ok)
+        if ok:
+            self.counters["objects_pushed"] = (
+                self.counters.get("objects_pushed", 0) + 1
+            )
+        return ok
+
+    async def _do_push(self, oid: ObjectID, node_id: str) -> bool:
+        peer = await self._peer(node_id)
+        if peer is None:
+            return False
+        buf = self.store.get(oid)
+        if buf is None:
+            return False
+        try:
+            total = len(buf.data)
+            chunk = cfg.object_transfer_chunk_bytes
+            # session nonce: the receiver assembles per (object, push_id),
+            # so interleaved pushes of the same object from two senders
+            # (possibly with different chunk sizes) can never mix chunks
+            push_id = f"{self.node_id[:8]}:{time.monotonic_ns()}"
+            sem = self._push_peer_sems.setdefault(
+                node_id, asyncio.Semaphore(cfg.push_max_chunks_in_flight)
+            )
+
+            async def send(payload):
+                try:
+                    reply = await peer.request(
+                        "push_chunks", payload, timeout=cfg.gcs_rpc_timeout_s
+                    )
+                    return bool(reply.get("ok") or reply.get("have"))
+                finally:
+                    sem.release()
+
+            sends = []
+            off = 0
+            while True:
+                data = bytes(buf.data[off:off + chunk])
+                payload = {
+                    "object_id": oid.binary(), "offset": off,
+                    "total": total, "data": data, "push_id": push_id,
+                }
+                if off == 0:
+                    payload["metadata"] = buf.metadata
+                await sem.acquire()
+                sends.append(
+                    asyncio.get_running_loop().create_task(send(payload))
+                )
+                off += len(data)
+                if off >= total:
+                    break
+            results = await asyncio.gather(*sends, return_exceptions=True)
+            return all(r is True for r in results)
+        finally:
+            buf.release()
+
+    def _expire_push_rx(self, now: float):
+        """Drop abandoned assemblies (sender died mid-push) and return
+        their byte charges to the transfer budget."""
+        for k, st in list(self._push_rx.items()):
+            if now - st["ts"] > 60.0:
+                self._push_rx.pop(k, None)
+                self._pull_gate.uncharge(st["total"])
+
+    async def rpc_push_chunks(self, conn: Connection, p):
+        """Receiver side: assemble out-of-order chunks of ONE push session
+        (keyed by (object, push_id) so concurrent senders never interleave);
+        finalize into the store and register the location when complete.
+        Inbound bytes charge the same transfer budget as pulls — blocking
+        here backpressures the sender through its chunk pipeline."""
+        oid = ObjectID(p["object_id"])
+        if self.store.contains(oid):
+            return {"have": True}
+        now = time.monotonic()
+        self._expire_push_rx(now)
+        key = (oid.binary(), p.get("push_id", ""))
+        st = self._push_rx.get(key)
+        if st is None:
+            await self._pull_gate.charge(p["total"])
+            if self.store.contains(oid):  # landed while we waited
+                self._pull_gate.uncharge(p["total"])
+                return {"have": True}
+            st = self._push_rx[key] = {
+                "parts": {}, "meta": None, "total": p["total"], "ts": now,
+            }
+        st["ts"] = now
+        st["parts"][p["offset"]] = p["data"]
+        if p.get("metadata") is not None:
+            st["meta"] = p["metadata"]
+        got = sum(len(d) for d in st["parts"].values())
+        if got >= st["total"]:
+            parts = [st["parts"][k] for k in sorted(st["parts"])]
+            if not self.store.contains(oid):
+                self.store.put(oid, st["meta"], parts, st["total"])
+            self._push_rx.pop(key, None)
+            self._pull_gate.uncharge(st["total"])
+            # unblock local pull waiters and register the new copy
+            fut = self._pulls_inflight.get(oid.binary())
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            try:
+                await self.gcs.request(
+                    "add_object_location",
+                    {"object_id": oid.binary(), "node_id": self.node_id},
+                )
+            except Exception:
+                pass
+            self._dispatch_event.set()
+        return {"ok": True}
+
+    async def rpc_push_object(self, conn: Connection, p):
+        """Driver-facing: push a (locally ensured) object to peers."""
+        oid = ObjectID(p["object_id"])
+        if not await self._ensure_local(oid.binary(), priority=PULL_PRIO_GET):
+            return {"ok": False, "error": "object not obtainable locally"}
+        results = await asyncio.gather(
+            *[self.push_object(oid, n) for n in p["node_ids"]
+              if n != self.node_id]
+        )
+        return {"ok": all(results), "pushed": sum(bool(r) for r in results)}
+
+    async def rpc_broadcast_object(self, conn: Connection, p):
+        """Binary-tree broadcast: push to the head of each half of the
+        target list, then delegate the rest of that half to the head —
+        log2 depth, every link pushes at full chunk pipeline (ray parity:
+        the reference's 1GiB-to-N-nodes broadcast benchmark shape)."""
+        oid = ObjectID(p["object_id"])
+        entered = time.monotonic()
+        # the caller's remaining time budget rides down the tree so deep
+        # hops don't spuriously time out on big broadcasts
+        budget = float(p.get("timeout") or cfg.object_pull_timeout_s * 4)
+        if not await self._ensure_local(oid.binary(), priority=PULL_PRIO_GET):
+            return {"ok": False, "error": "object not obtainable locally"}
+        targets = [n for n in p["node_ids"] if n != self.node_id]
+        if not targets:
+            return {"ok": True, "nodes": 0}
+
+        async def fan(half):
+            try:
+                if not half:
+                    return True
+                head, rest = half[0], half[1:]
+                if not await self.push_object(oid, head):
+                    # head unreachable: flat-push the rest from here instead
+                    results = await asyncio.gather(
+                        *[self.push_object(oid, n) for n in rest]
+                    )
+                    return all(results)
+                if not rest:
+                    return True
+                peer = await self._peer(head)
+                if peer is None:
+                    return False
+                remaining = max(1.0, budget - (time.monotonic() - entered))
+                reply = await peer.request(
+                    "broadcast_object",
+                    {"object_id": oid.binary(), "node_ids": rest,
+                     "timeout": remaining * 0.9},
+                    timeout=remaining,
+                )
+                return bool(reply.get("ok"))
+            except Exception as e:  # noqa: BLE001 — a failed half must not
+                # cancel the sibling half's in-flight pushes
+                logger.warning("broadcast subtree failed: %s", e)
+                return False
+
+        mid = (len(targets) + 1) // 2
+        ok = await asyncio.gather(
+            fan(targets[:mid]), fan(targets[mid:]), return_exceptions=True
+        )
+        return {"ok": all(r is True for r in ok), "nodes": len(targets)}
 
     async def rpc_fetch_object(self, conn: Connection, p):
         oid = ObjectID(p["object_id"])
