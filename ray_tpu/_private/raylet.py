@@ -545,6 +545,13 @@ class Raylet:
                     self._on_view(reply["nodes"])
             except Exception:
                 pass
+            # reclaim byte charges of push sessions whose sender died
+            # (waiting for the next inbound push to sweep could wedge the
+            # shared transfer budget indefinitely)
+            try:
+                self._expire_push_rx(time.monotonic())
+            except Exception:
+                pass
             await asyncio.sleep(cfg.heartbeat_interval_s)
 
     # ------------------------------------------------------------------
@@ -574,6 +581,7 @@ class Raylet:
             me.resources_total = self.resources_total
         for node_id in died:
             self._resubmit_spilled_to(node_id)
+            self._push_peer_sems.pop(node_id, None)
         self._dispatch_event.set()
 
     def _resubmit_spilled_to(self, node_id: str):
@@ -647,7 +655,11 @@ class Raylet:
             if kind == "worker":
                 return self._on_worker_conn_lost(cid)
         elif kind == "peer":
-            self.peers.pop(conn.meta.get("node_id"), None)
+            peer_id = conn.meta.get("node_id")
+            self.peers.pop(peer_id, None)
+            # drop the per-peer push pipeline with the peer (elastic
+            # clusters churn nodes; semaphores must not accumulate)
+            self._push_peer_sems.pop(peer_id, None)
 
     async def _on_worker_conn_lost(self, client_id: str):
         w = self.workers_by_client.pop(client_id, None)
@@ -1388,18 +1400,27 @@ class Raylet:
                 node_id, asyncio.Semaphore(cfg.push_max_chunks_in_flight)
             )
 
+            failed = [False]
+
             async def send(payload):
                 try:
                     reply = await peer.request(
                         "push_chunks", payload, timeout=cfg.gcs_rpc_timeout_s
                     )
-                    return bool(reply.get("ok") or reply.get("have"))
+                    ok = bool(reply.get("ok") or reply.get("have"))
+                except Exception:
+                    ok = False
                 finally:
                     sem.release()
+                if not ok:
+                    failed[0] = True
+                return ok
 
             sends = []
             off = 0
             while True:
+                if failed[0]:
+                    break  # a chunk already failed: stop wasting bandwidth
                 data = bytes(buf.data[off:off + chunk])
                 payload = {
                     "object_id": oid.binary(), "offset": off,
@@ -1415,7 +1436,8 @@ class Raylet:
                 if off >= total:
                     break
             results = await asyncio.gather(*sends, return_exceptions=True)
-            return all(r is True for r in results)
+            sent_all = off >= total and not failed[0]
+            return sent_all and all(r is True for r in results)
         finally:
             buf.release()
 
@@ -1445,9 +1467,16 @@ class Raylet:
             if self.store.contains(oid):  # landed while we waited
                 self._pull_gate.uncharge(p["total"])
                 return {"have": True}
-            st = self._push_rx[key] = {
-                "parts": {}, "meta": None, "total": p["total"], "ts": now,
-            }
+            # charge() suspended: a sibling chunk of this session may have
+            # created the state meanwhile — overwriting it would drop its
+            # chunk and leak a second charge
+            st = self._push_rx.get(key)
+            if st is not None:
+                self._pull_gate.uncharge(p["total"])
+            else:
+                st = self._push_rx[key] = {
+                    "parts": {}, "meta": None, "total": p["total"], "ts": now,
+                }
         st["ts"] = now
         st["parts"][p["offset"]] = p["data"]
         if p.get("metadata") is not None:

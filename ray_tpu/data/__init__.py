@@ -2,6 +2,7 @@
 python/ray/data)."""
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
@@ -25,6 +26,7 @@ from ray_tpu.data.read_api import (
 from ray_tpu.data import preprocessors
 
 __all__ = [
+    "DataContext",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, concat_blocks
 from ray_tpu.data._internal import logical as L
+from ray_tpu.data._internal.stats import ExecStats, OpStats
 
 logger = logging.getLogger(__name__)
 
@@ -27,11 +28,21 @@ RefBundle = Tuple[Any, BlockMetadata]  # (ObjectRef[Block], meta)
 
 
 class ExecutionOptions:
-    def __init__(self, max_in_flight: int = 8, preserve_order: bool = True,
-                 resources: Optional[dict] = None):
-        self.max_in_flight = max_in_flight
-        self.preserve_order = preserve_order
+    def __init__(self, max_in_flight: Optional[int] = None,
+                 preserve_order: Optional[bool] = None,
+                 resources: Optional[dict] = None,
+                 op_memory_budget: Optional[int] = None):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        self.max_in_flight = max_in_flight if max_in_flight is not None \
+            else ctx.max_in_flight_tasks
+        self.preserve_order = preserve_order if preserve_order is not None \
+            else ctx.preserve_order
         self.resources = resources or {}
+        self.op_memory_budget = op_memory_budget if op_memory_budget \
+            is not None else ctx.op_memory_budget
+        self.block_size_seed = ctx.target_max_block_size
 
 
 # ----------------------------------------------------------------------
@@ -69,15 +80,34 @@ class _MapWorker:
 # ----------------------------------------------------------------------
 
 def _windowed(task_iter: Iterator[Callable[[], List[Any]]],
-              window: int, preserve_order: bool) -> Iterator[RefBundle]:
-    """Submit thunks from ``task_iter`` keeping <= window in flight; yield
-    (block_ref, meta) as tasks complete."""
+              opts: ExecutionOptions,
+              stats: Optional[OpStats] = None,
+              window: Optional[int] = None) -> Iterator[RefBundle]:
+    """Submit thunks from ``task_iter`` under DOUBLE backpressure: at most
+    ``window`` tasks in flight AND an estimated in-flight output-byte
+    budget (ray parity: streaming_executor_state.py:100,376 — per-operator
+    memory budgets, not just task counts). Output size is estimated from
+    the running mean of this operator's completed blocks (seeded with
+    target_max_block_size); at least one task is always admitted so a
+    single huge block still flows."""
+    import time as _time
+
     import ray_tpu
 
+    window = window or opts.max_in_flight
+    budget = opts.op_memory_budget
+    avg_bytes = float(opts.block_size_seed)
+    done_count = 0
+    bp_started: Optional[float] = None
     in_flight: List[Tuple[Any, Any]] = []  # (meta_ref, block_ref)
     exhausted = False
     while in_flight or not exhausted:
         while not exhausted and len(in_flight) < window:
+            if in_flight and avg_bytes * (len(in_flight) + 1) > budget:
+                # over the memory budget: drain one completion first
+                if bp_started is None:
+                    bp_started = _time.perf_counter()
+                break
             try:
                 thunk = next(task_iter)
             except StopIteration:
@@ -85,9 +115,13 @@ def _windowed(task_iter: Iterator[Callable[[], List[Any]]],
                 break
             block_ref, meta_ref = thunk()
             in_flight.append((meta_ref, block_ref))
+            if stats is not None:
+                stats.peak_inflight_tasks = max(
+                    stats.peak_inflight_tasks, len(in_flight)
+                )
         if not in_flight:
             break
-        if preserve_order:
+        if opts.preserve_order:
             meta_ref, block_ref = in_flight.pop(0)
             meta = ray_tpu.get(meta_ref)
         else:
@@ -97,10 +131,21 @@ def _windowed(task_iter: Iterator[Callable[[], List[Any]]],
             idx = next(i for i, (m, _) in enumerate(in_flight) if m in ready)
             meta_ref, block_ref = in_flight.pop(idx)
             meta = ray_tpu.get(meta_ref)
+        if bp_started is not None:
+            if stats is not None:
+                stats.backpressure_s += _time.perf_counter() - bp_started
+            bp_started = None
+        if stats is not None:
+            stats.record_output(meta)
+        # refine the per-task output estimate with the observed mean
+        done_count += 1
+        size = meta.size_bytes or 0
+        avg_bytes += (size - avg_bytes) / done_count
         yield block_ref, meta
 
 
-def _read_stage(op: L.Read, opts: ExecutionOptions) -> Iterator[RefBundle]:
+def _read_stage(op: L.Read, opts: ExecutionOptions,
+                stats: Optional[OpStats] = None) -> Iterator[RefBundle]:
     import ray_tpu
 
     read_remote = ray_tpu.remote(num_returns=2)(_run_read_task)
@@ -109,11 +154,12 @@ def _read_stage(op: L.Read, opts: ExecutionOptions) -> Iterator[RefBundle]:
         for rt in op.read_tasks:
             yield lambda rt=rt: read_remote.remote(rt)
 
-    return _windowed(thunks(), opts.max_in_flight, opts.preserve_order)
+    return _windowed(thunks(), opts, stats=stats)
 
 
 def _map_stage(op: L.MapBlocks, upstream: Iterator[RefBundle],
-               opts: ExecutionOptions) -> Iterator[RefBundle]:
+               opts: ExecutionOptions,
+               stats: Optional[OpStats] = None) -> Iterator[RefBundle]:
     import ray_tpu
 
     if op.compute is None:
@@ -128,7 +174,7 @@ def _map_stage(op: L.MapBlocks, upstream: Iterator[RefBundle],
             for block_ref, _meta in upstream:
                 yield lambda b=block_ref: map_remote.remote(fn, b)
 
-        return _windowed(thunks(), opts.max_in_flight, opts.preserve_order)
+        return _windowed(thunks(), opts, stats=stats)
 
     # actor pool
     _, pool_size = op.compute
@@ -155,7 +201,8 @@ def _map_stage(op: L.MapBlocks, upstream: Iterator[RefBundle],
     def run():
         try:
             yield from _windowed(
-                thunks(), max(opts.max_in_flight, pool_size), opts.preserve_order
+                thunks(), opts, stats=stats,
+                window=max(opts.max_in_flight, pool_size),
             )
         finally:
             for a in actors:
@@ -262,37 +309,69 @@ def shuffle_exchange(bundles: List[RefBundle], n_out: int,
 # ----------------------------------------------------------------------
 
 def execute_streaming(plan: L.LogicalPlan,
-                      opts: Optional[ExecutionOptions] = None
+                      opts: Optional[ExecutionOptions] = None,
+                      stats: Optional[ExecStats] = None
                       ) -> Iterator[RefBundle]:
-    """Yield output (block_ref, meta) pairs of the optimized plan."""
+    """Yield output (block_ref, meta) pairs of the optimized plan; fill
+    ``stats`` (one OpStats per operator) while running."""
     opts = opts or ExecutionOptions()
-    return _exec_op(plan.optimized().dag, opts)
+    out = _exec_op(plan.optimized().dag, opts, stats)
+
+    if stats is None:
+        return out
+
+    def finalize():
+        try:
+            yield from out
+        finally:
+            stats.finalize()
+
+    return finalize()
 
 
 def execute(plan: L.LogicalPlan,
-            opts: Optional[ExecutionOptions] = None) -> List[RefBundle]:
-    return list(execute_streaming(plan, opts))
+            opts: Optional[ExecutionOptions] = None,
+            stats: Optional[ExecStats] = None) -> List[RefBundle]:
+    return list(execute_streaming(plan, opts, stats))
 
 
-def _exec_op(op: L.LogicalOp, opts: ExecutionOptions) -> Iterator[RefBundle]:
+def _stat(stats: Optional[ExecStats], name: str) -> Optional[OpStats]:
+    if stats is None:
+        return None
+    st = stats.op(name)
+    st.start()
+    return st
+
+
+def _exec_op(op: L.LogicalOp, opts: ExecutionOptions,
+             stats: Optional[ExecStats] = None) -> Iterator[RefBundle]:
     if isinstance(op, L.InputData):
         return iter(list(zip(op.refs, op.metas)))
     if isinstance(op, L.Read):
-        return _read_stage(op, opts)
+        return _read_stage(op, opts, _stat(stats, op.name))
     if isinstance(op, L.MapBlocks):
-        return _map_stage(op, _exec_op(op.inputs[0], opts), opts)
+        return _map_stage(
+            op, _exec_op(op.inputs[0], opts, stats), opts,
+            _stat(stats, op.name),
+        )
     if isinstance(op, L.Limit):
-        return _limit_stage(op, _exec_op(op.inputs[0], opts))
+        return _limit_stage(op, _exec_op(op.inputs[0], opts, stats))
     if isinstance(op, L.AllToAll):
-        bundles = list(_exec_op(op.inputs[0], opts))
-        return iter(op.fn(bundles))
+        bundles = list(_exec_op(op.inputs[0], opts, stats))
+        st = _stat(stats, op.name)
+        out = op.fn(bundles)
+        if st is not None:
+            for _, meta in out:
+                st.record_output(meta)
+            st.finish()
+        return iter(out)
     if isinstance(op, L.Union):
         def chain():
             for child in op.inputs:
-                yield from _exec_op(child, opts)
+                yield from _exec_op(child, opts, stats)
         return chain()
     if isinstance(op, L.Zip):
-        left = list(_exec_op(op.inputs[0], opts))
-        right = list(_exec_op(op.inputs[1], opts))
+        left = list(_exec_op(op.inputs[0], opts, stats))
+        right = list(_exec_op(op.inputs[1], opts, stats))
         return _zip_stage(left, right)
     raise TypeError(f"unknown logical op {op!r}")

@@ -85,6 +85,7 @@ class Dataset:
     def __init__(self, dag: L.LogicalOp):
         self._dag = dag
         self._cached: Optional[List[X.RefBundle]] = None
+        self._exec_stats = None  # ExecStats from the last execution
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -300,16 +301,30 @@ class Dataset:
     # ------------------------------------------------------------------
     def _bundles(self) -> List[X.RefBundle]:
         if self._cached is None:
-            self._cached = X.execute(self._plan())
+            from ray_tpu.data.context import DataContext
+            from ray_tpu.data._internal.stats import ExecStats
+
+            stats = ExecStats() if DataContext.get_current().enable_stats \
+                else None
+            self._cached = X.execute(self._plan(), stats=stats)
+            self._exec_stats = stats
         return self._cached
 
     def iter_bundles(self) -> Iterator[X.RefBundle]:
         if self._cached is not None:
             return iter(self._cached)
-        return X.execute_streaming(self._plan())
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data._internal.stats import ExecStats
+
+        stats = ExecStats() if DataContext.get_current().enable_stats \
+            else None
+        self._exec_stats = stats
+        return X.execute_streaming(self._plan(), stats=stats)
 
     def materialize(self) -> "Dataset":
-        return Dataset.from_bundles(self._bundles())
+        out = Dataset.from_bundles(self._bundles())
+        out._exec_stats = self._exec_stats  # stats survive materialization
+        return out
 
     def count(self) -> int:
         return sum(m.num_rows for _, m in self._bundles())
@@ -565,14 +580,22 @@ class Dataset:
 
     # ------------------------------------------------------------------
     def stats(self) -> str:
+        """Per-operator execution stats (ray parity: Dataset.stats() /
+        _internal/stats.py DatasetStats summary). Covers both cached
+        executions and drained streaming iterations (iter_bundles)."""
         bundles = self._cached
         if bundles is None:
+            if self._exec_stats is not None and self._exec_stats.ops:
+                return self._exec_stats.summary()
             return "(dataset not yet executed)"
-        return (
+        head = (
             f"Dataset: {len(bundles)} blocks, "
             f"{sum(m.num_rows for _, m in bundles)} rows, "
             f"{sum(m.size_bytes for _, m in bundles)} bytes"
         )
+        if self._exec_stats is not None and self._exec_stats.ops:
+            return head + "\n" + self._exec_stats.summary()
+        return head
 
     def __repr__(self):
         name = self._dag.name
