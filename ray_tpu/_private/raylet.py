@@ -1659,6 +1659,29 @@ class Raylet:
         return {}
 
     # ------------------------------------------------------------------
+    # profiling (ray: dashboard reporter's py-spy stack dumps — here the
+    # workers self-report via sys._current_frames)
+    # ------------------------------------------------------------------
+    async def rpc_node_stacks(self, conn: Connection, p):
+        """Stack dumps of every live worker on this node, gathered
+        CONCURRENTLY — wedged workers are the very thing this exists to
+        debug; waiting 10s for each in turn would blow the caller's
+        budget and drop the healthy workers' stacks too."""
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+
+        async def dump(w):
+            try:
+                return await w.conn.request("dump_stacks", {}, timeout=10.0)
+            except Exception:
+                return {"pid": w.proc.pid, "error": "unreachable"}
+
+        dumps = list(await asyncio.gather(*[dump(w) for w in live]))
+        return {"node_id": self.node_id, "workers": dumps}
+
+    # ------------------------------------------------------------------
     # placement groups (bundle resources; 2-phase)
     # ------------------------------------------------------------------
     async def rpc_pg_prepare(self, conn: Connection, p):

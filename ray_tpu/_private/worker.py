@@ -744,6 +744,27 @@ class CoreWorker:
             return {"pending": True}
         return {"unknown": True}
 
+    async def rpc_dump_stacks(self, conn: Connection, p):
+        """Thread stack dump of this process (ray parity:
+        dashboard/modules/reporter/profile_manager.py py-spy dump — here
+        native sys._current_frames, no external profiler needed)."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        current = getattr(self, "executor", None)
+        task = getattr(current, "current_task_id", None) if current else None
+        for ident, frame in sys._current_frames().items():
+            stack = "".join(traceback.format_stack(frame))
+            out[f"{names.get(ident, '?')}-{ident}"] = stack
+        return {
+            "pid": os.getpid(),
+            "client_id": self.client_id,
+            "current_task": task.hex()[:16] if task else None,
+            "threads": out,
+        }
+
     async def rpc_pubsub(self, conn: Connection, p):
         self._dispatch_pubsub(p["channel"], p["message"])
 

@@ -99,6 +99,14 @@ def _build_app():
             text=text, content_type="text/plain", charset="utf-8"
         )
 
+    @routes.get("/api/v0/stacks")
+    async def stacks(request):
+        node_id = request.query.get("node_id")
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.get_stacks(node_id=node_id)
+        )
+        return _json_response(out)
+
     @routes.get("/api/v0/events")
     async def events(request):
         from ray_tpu.util import events as ev

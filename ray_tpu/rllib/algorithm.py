@@ -51,6 +51,16 @@ class AlgorithmConfig:
         self.num_learners = 0
         self.num_cpus_per_learner = 0.5
         self.num_tpus_per_learner = 0  # >0: learner actors claim chips
+        # connectors (ray parity: ConnectorV2 / classic MeanStdFilter):
+        # "MeanStdFilter" normalizes observations on every runner with
+        # cross-runner stat merging each iteration
+        self.observation_filter: Optional[str] = None
+        # evaluation plane (ray parity: config.evaluation(...) +
+        # evaluation workers): a separate runner gang scores the greedy
+        # policy every evaluation_interval train iterations
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_num_env_runners = 0
+        self.evaluation_duration = 5  # episodes per eval runner
         self.lr = 5e-3
         self.gamma = 0.99
         self.lambda_ = 0.95
@@ -78,11 +88,25 @@ class AlgorithmConfig:
         return self
 
     def env_runners(self, *, num_env_runners=None,
-                    rollout_fragment_length=None, **_kw):
+                    rollout_fragment_length=None,
+                    observation_filter=None, **_kw):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
+        return self
+
+    def evaluation(self, *, evaluation_interval=None,
+                   evaluation_num_env_runners=None,
+                   evaluation_duration=None, **_kw):
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = evaluation_num_env_runners
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
         return self
 
     # accepted for reference-API compatibility
@@ -219,15 +243,25 @@ class Algorithm(Trainable):
             cfg.env, cfg.env_config,
             {"hiddens": tuple(cfg.model.get("hiddens", (64, 64)))},
             seed=cfg.seed + i,
+            observation_filter=getattr(cfg, "observation_filter", None),
         )
         self.runners = [
             self._runner_factory(i) for i in range(cfg.num_env_runners)
+        ]
+        # evaluation gang: separate actors so eval episodes never disturb
+        # the training runners' env cursors or filter stats (ray parity:
+        # evaluation workers / evaluation_num_env_runners)
+        self.eval_runners = [
+            self._runner_factory(10_000 + i)
+            for i in range(getattr(cfg, "evaluation_num_env_runners", 0))
         ]
         self._timesteps = 0
 
     def step(self) -> Dict:
         metrics = self.training_step()
+        self._train_iter = getattr(self, "_train_iter", 0) + 1
         metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        self._sync_connectors()
         runner_metrics = self._with_runner_ft(lambda: ray_tpu.get(
             [r.get_metrics.remote() for r in self.runners]
         ))
@@ -240,7 +274,41 @@ class Algorithm(Trainable):
             metrics["episode_return_mean"] = float(np.mean(returns))
             # legacy metric name used across reference tooling
             metrics["episode_reward_mean"] = metrics["episode_return_mean"]
+        interval = getattr(self.config, "evaluation_interval", None)
+        if interval and self._train_iter % interval == 0:
+            metrics.update(self.evaluate())
         return metrics
+
+    def _sync_connectors(self):
+        """Pull each runner's observation DELTAS (cleared on pop), fold
+        them into the global filter state, and redistribute the global
+        (ray parity: FilterManager.synchronize — merging absolute states
+        instead would compound counts ~num_runners^iteration)."""
+        if not getattr(self.config, "observation_filter", None):
+            return
+        from ray_tpu.rllib.connectors import merge_pipeline_states
+
+        try:
+            deltas = ray_tpu.get(
+                [r.pop_connector_delta.remote() for r in self.runners],
+                timeout=120,
+            )
+        except Exception:
+            return  # dead runner: _restore_dead_runners handles it
+        merged = merge_pipeline_states(
+            [d for d in deltas] + [getattr(self, "_connector_state", None)]
+        )
+        if merged is None:
+            return
+        self._connector_state = merged
+        targets = self.runners + getattr(self, "eval_runners", [])
+        try:
+            ray_tpu.get(
+                [r.set_connector_state.remote(merged) for r in targets],
+                timeout=120,
+            )
+        except Exception:
+            pass
 
     def training_step(self) -> Dict:
         raise NotImplementedError
@@ -292,6 +360,33 @@ class Algorithm(Trainable):
         if replaced:
             log.warning("replaced %d dead env runner(s)", replaced)
         return replaced
+
+    def _restore_dead_eval_runners(self):
+        """Probe+replace the evaluation gang (mirrors
+        _restore_dead_runners for the training gang)."""
+        probes = [r.ping.remote() for r in self.eval_runners]
+        for i, p in enumerate(probes):
+            try:
+                ray_tpu.get(p, timeout=120)
+                continue
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(self.eval_runners[i])
+            except Exception:
+                pass
+            self.eval_runners[i] = self._runner_factory(
+                10_000 + i, replacement=True
+            )
+            conn = getattr(self, "_connector_state", None)
+            if conn:
+                try:
+                    ray_tpu.get(
+                        self.eval_runners[i].set_connector_state.remote(conn),
+                        timeout=120,
+                    )
+                except Exception:
+                    pass
 
     def _with_runner_ft(self, fn, attempts: int = 3):
         """Run a fan-out; on failure restore dead runners and retry.
@@ -349,7 +444,8 @@ class Algorithm(Trainable):
     def save_checkpoint(self, checkpoint_dir=None) -> Dict:
         return {"weights": self.learner.get_weights(),
                 "opt_state": self.learner.get_optimizer_state(),
-                "timesteps": self._timesteps}
+                "timesteps": self._timesteps,
+                "connectors": getattr(self, "_connector_state", None)}
 
     def load_checkpoint(self, checkpoint: Optional[Dict]):
         if checkpoint:
@@ -360,9 +456,20 @@ class Algorithm(Trainable):
             self.module.set_state(checkpoint["weights"])
             self._timesteps = checkpoint.get("timesteps", 0)
             self._sync_weights()
+            conn = checkpoint.get("connectors")
+            if conn:
+                self._connector_state = conn
+                targets = self.runners + getattr(self, "eval_runners", [])
+                try:
+                    ray_tpu.get(
+                        [r.set_connector_state.remote(conn) for r in targets],
+                        timeout=120,
+                    )
+                except Exception:
+                    pass
 
     def cleanup(self):
-        for r in getattr(self, "runners", []):
+        for r in getattr(self, "runners", []) + getattr(self, "eval_runners", []):
             try:
                 ray_tpu.kill(r)
             except Exception:
@@ -378,8 +485,41 @@ class Algorithm(Trainable):
         super().stop()
 
     def evaluate(self) -> Dict:
-        score = ray_tpu.get(self.runners[0].evaluate.remote(5), timeout=300)
-        return {"evaluation": {"episode_return_mean": score}}
+        """Greedy-policy evaluation. With an eval gang configured
+        (evaluation_num_env_runners > 0) the episodes run on dedicated
+        workers in parallel with fresh weights; otherwise on training
+        runner 0 (ray parity: Algorithm.evaluate / evaluation workers)."""
+        episodes = getattr(self.config, "evaluation_duration", 5)
+        gang = getattr(self, "eval_runners", [])
+        if gang:
+            def run_gang():
+                weights = ray_tpu.put(self.learner.get_weights())
+                ray_tpu.get(
+                    [r.set_weights.remote(weights) for r in self.eval_runners],
+                    timeout=120,
+                )
+                return ray_tpu.get(
+                    [r.evaluate.remote(episodes) for r in self.eval_runners],
+                    timeout=600,
+                )
+
+            try:
+                scores = run_gang()
+            except Exception:
+                # same FT discipline as the training gang: replace the
+                # dead, retry once — a lost eval runner must not fail an
+                # otherwise healthy trial
+                self._restore_dead_eval_runners()
+                scores = run_gang()
+            return {"evaluation": {
+                "episode_return_mean": float(np.mean(scores)),
+                "num_episodes": episodes * len(gang),
+            }}
+        score = ray_tpu.get(
+            self.runners[0].evaluate.remote(episodes), timeout=600
+        )
+        return {"evaluation": {"episode_return_mean": score,
+                               "num_episodes": episodes}}
 
 
 class PPO(Algorithm):

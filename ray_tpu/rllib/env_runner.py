@@ -109,22 +109,61 @@ class _RunnerBase:
 
 class EnvRunner(_RunnerBase):
     def __init__(self, env_spec: Any, env_config: Optional[dict],
-                 module_kwargs: Dict, seed: int = 0):
+                 module_kwargs: Dict, seed: int = 0,
+                 observation_filter: Optional[str] = None):
         super().__init__(env_spec, env_config, seed)
         obs_shape, num_actions = env_spaces(self.env)
         self.module = RLModule(obs_shape, num_actions, seed=seed,
                                **module_kwargs)
+        # env-to-module connector pipeline (ray parity: ConnectorV2 /
+        # MeanStdFilter): observations normalize before the policy AND
+        # before entering the train batch, stats sync via
+        # get/set_connector_state each iteration.
+        from ray_tpu.rllib.connectors import build_obs_pipeline
+
+        self._obs_pipeline = build_obs_pipeline(observation_filter, obs_shape)
+        if self._obs_pipeline is not None:
+            # the reset obs from _RunnerBase.__init__ is an observation too
+            self._obs_pipeline(self._obs, update=True)
+
+    def _reset_sampling_state(self):
+        super()._reset_sampling_state()
+        if self._obs_pipeline is not None:
+            self._obs_pipeline(self._obs, update=True)
+
+    def _filt(self, obs, update: bool):
+        if self._obs_pipeline is None:
+            return np.asarray(obs, np.float32)
+        return self._obs_pipeline(obs, update=update)
+
+    def get_connector_state(self) -> Optional[dict]:
+        """Absolute pipeline state (checkpointing/tests)."""
+        if self._obs_pipeline is None:
+            return None
+        return self._obs_pipeline.get_state()
+
+    def pop_connector_delta(self) -> Optional[dict]:
+        """Observations since the last sync; clears the delta buffer
+        (ray parity: FilterManager.synchronize pulls+clears buffers)."""
+        if self._obs_pipeline is None:
+            return None
+        return self._obs_pipeline.pop_delta_state()
+
+    def set_connector_state(self, state: Optional[dict]):
+        if self._obs_pipeline is not None and state:
+            self._obs_pipeline.set_state(state)
+        return True
 
     def _eval_action(self, obs):
         return int(self.module.action_greedy(
-            np.asarray(obs, np.float32)[None, :]
+            self._filt(obs, update=False)[None, :]
         )[0])
 
-    def _value_of(self, obs) -> float:
+    def _value_of(self, obs_f) -> float:
         import jax
 
         _, _, v = self.module.action_exploration(
-            np.asarray(obs, np.float32)[None, :], jax.random.PRNGKey(0)
+            np.asarray(obs_f, np.float32)[None, :], jax.random.PRNGKey(0)
         )
         return float(v[0])
 
@@ -136,14 +175,16 @@ class EnvRunner(_RunnerBase):
         )
         next_obs_buf, trunc_buf, vf_next_buf = [], [], []
         for _ in range(num_steps):
+            # current obs's filter stats were updated when it was first
+            # observed; normalize with the frozen view here
+            fobs = self._filt(self._obs, update=False)
             self._key, sub = jax.random.split(self._key)
-            a, logp, v = self.module.action_exploration(
-                self._obs[None, :], sub
-            )
+            a, logp, v = self.module.action_exploration(fobs[None, :], sub)
             action = int(a[0])
             nxt, reward, terminated, truncated, _ = self.env.step(action)
-            obs_buf.append(self._obs)
-            next_obs_buf.append(nxt)
+            fnxt = self._filt(nxt, update=True)  # a NEW observation
+            obs_buf.append(fobs)
+            next_obs_buf.append(fnxt)
             act_buf.append(action)
             rew_buf.append(reward)
             # bootstrap through time-limit truncation, not termination
@@ -157,16 +198,19 @@ class EnvRunner(_RunnerBase):
                 # V of the episode's final obs, captured BEFORE reset —
                 # GAE must bootstrap from the truncated state, not the
                 # new episode's reset obs.
-                vf_next_buf.append(self._value_of(nxt))
+                vf_next_buf.append(self._value_of(fnxt))
             else:
                 vf_next_buf.append(np.nan)  # = values[t+1], filled below
-            self._end_step(reward, terminated, truncated, nxt)
+            if self._end_step(reward, terminated, truncated, nxt) and \
+                    self._obs_pipeline is not None:
+                # episode boundary: the reset obs is a new observation
+                self._obs_pipeline(self._obs, update=True)
         values = np.asarray(val_buf, np.float32)
         vf_next = np.asarray(vf_next_buf, np.float32)
         # Fill mid-episode steps with the next step's on-policy value; the
         # fragment's last step (if mid-episode) bootstraps from the live obs.
         if num_steps and np.isnan(vf_next[-1]):
-            vf_next[-1] = self._value_of(self._obs)
+            vf_next[-1] = self._value_of(self._filt(self._obs, update=False))
         nan_mask = np.isnan(vf_next)
         if nan_mask.any():
             vf_next[nan_mask] = values[1:][nan_mask[:-1]]

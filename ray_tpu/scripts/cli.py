@@ -151,6 +151,31 @@ def cmd_status(args):
     ray_tpu.shutdown()
 
 
+def cmd_stack(args):
+    """ray parity: `ray stack` (py-spy dump of every worker)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    for node in state.get_stacks(node_id=args.node_id):
+        print(f"=== node {node.get('node_id', '?')[:12]} ===")
+        if node.get("error"):
+            print(f"  ({node['error']})")
+            continue
+        for wk in node.get("workers", ()):
+            task = f" task={wk['current_task']}" if wk.get("current_task") \
+                else ""
+            print(f"--- worker pid={wk.get('pid')}{task} ---")
+            if wk.get("error"):
+                print(f"  ({wk['error']})")
+                continue
+            for tname, stack in wk.get("threads", {}).items():
+                print(f"  [{tname}]")
+                for line in stack.rstrip().split("\n"):
+                    print(f"    {line}")
+    ray_tpu.shutdown()
+
+
 def cmd_events(args):
     import ray_tpu
     from ray_tpu.util import events as ev
@@ -369,6 +394,11 @@ def main(argv=None):
     jp.add_argument("submission_id")
     jp.add_argument("--address")
     jp.set_defaults(fn=cmd_job_stop)
+
+    p = sub.add_parser("stack", help="dump worker thread stacks")
+    p.add_argument("--address")
+    p.add_argument("--node-id")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("events", help="show structured cluster events")
     p.add_argument("--address")

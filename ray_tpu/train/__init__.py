@@ -19,6 +19,7 @@ from ray_tpu.train.trainer import (
     SklearnTrainer,
     TensorflowTrainer,
 )
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [

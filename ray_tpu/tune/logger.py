@@ -114,4 +114,79 @@ class CSVLoggerCallback(_PerTrialFileCallback):
         f.flush()
 
 
-DEFAULT_CALLBACKS = (CSVLoggerCallback, JsonLoggerCallback)
+class TBXLoggerCallback(Callback):
+    """TensorBoard event files per trial (ray parity:
+    tune/logger/tensorboardx.py TBXLoggerCallback — same event-file
+    layout: one writer per trial directory, numeric leaves of the result
+    dict become scalars keyed by their flattened path). Uses
+    torch.utils.tensorboard, which this image bundles; constructing the
+    callback without it raises ImportError up front."""
+
+    def __init__(self):
+        from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+
+        self._writers: Dict[str, "SummaryWriter"] = {}
+
+    def _writer(self, trial):
+        w = self._writers.get(trial.trial_id)
+        if w is None and trial.local_path:
+            from torch.utils.tensorboard import SummaryWriter
+
+            os.makedirs(trial.local_path, exist_ok=True)
+            w = SummaryWriter(log_dir=trial.local_path)
+            self._writers[trial.trial_id] = w
+        return w
+
+    def on_trial_result(self, trial, result: Dict):
+        w = self._writer(trial)
+        if w is None:
+            return
+        step = result.get("training_iteration") or result.get(
+            "timesteps_total"
+        ) or 0
+        for key, v in _flatten(result).items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            w.add_scalar(key, v, global_step=int(step))
+        w.flush()
+
+    def _close(self, trial):
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()
+
+    def on_trial_complete(self, trial):
+        self._close(trial)
+
+    def on_trial_error(self, trial):
+        self._close(trial)
+
+    def on_experiment_end(self, controller):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
+def _default_callbacks():
+    """CSV + JSON always; TensorBoard when available (ray parity:
+    DEFAULT_LOGGERS includes TBX when the dependency is present).
+    Availability is probed with find_spec, NOT an import: this module is
+    (un)pickled into every worker, and importing torch+tensorboard there
+    costs tens of seconds on small hosts — enough to time out actor
+    creation. The real import happens lazily in the driver when the
+    first writer is built."""
+    import importlib.util
+
+    cbs = [CSVLoggerCallback, JsonLoggerCallback]
+    try:
+        # top-level names only: find_spec on a dotted path IMPORTS the
+        # parent packages, which would pull torch into every worker
+        if importlib.util.find_spec("torch") is not None and \
+                importlib.util.find_spec("tensorboard") is not None:
+            cbs.append(TBXLoggerCallback)
+    except (ImportError, ModuleNotFoundError, ValueError):
+        pass
+    return tuple(cbs)
+
+
+DEFAULT_CALLBACKS = _default_callbacks()

@@ -26,6 +26,7 @@ __all__ = [
     "list_workers",
     "summarize_tasks",
     "get_node_stats",
+    "get_stacks",
     "timeline",
 ]
 
@@ -121,24 +122,50 @@ def list_workers(filters: Optional[Iterable[Tuple]] = None,
     return _apply_filters(rows, filters, limit)
 
 
-def get_node_stats(node_id: str) -> Optional[dict]:
+def _node_request(node: dict, method: str, payload=None,
+                  timeout: Optional[float] = None) -> Optional[dict]:
+    """One request to a raylet discovered from the nodes table (shared
+    connect/request/teardown choreography for per-node probes)."""
     from ray_tpu._private.rpcio import EventLoopThread, connect
+
+    io = EventLoopThread("state-probe")
+    try:
+        conn = io.run(connect(node["host"], node["port"], retries=2))
+        reply = io.run(conn.request(method, payload or {}, timeout=timeout))
+        io.run(conn.close())
+        return reply
+    except Exception:
+        return None
+    finally:
+        io.stop()
+
+
+def get_stacks(node_id: Optional[str] = None) -> List[dict]:
+    """Thread stack dumps of every worker, per node (ray parity:
+    `ray stack` / dashboard reporter's py-spy dump — here workers
+    self-report via sys._current_frames, offline-safe)."""
     from ray_tpu._private.worker import global_worker
 
     global_worker.check_connected()
-    cw = global_worker.core_worker
+    out: List[dict] = []
+    for node in _gcs_request("get_nodes"):
+        if not node["alive"]:
+            continue
+        if node_id is not None and node["node_id"] != node_id:
+            continue
+        reply = _node_request(node, "node_stacks", timeout=30)
+        out.append(reply if reply is not None else
+                   {"node_id": node["node_id"], "error": "unreachable"})
+    return out
+
+
+def get_node_stats(node_id: str) -> Optional[dict]:
+    from ray_tpu._private.worker import global_worker
+
+    global_worker.check_connected()
     for node in _gcs_request("get_nodes"):
         if node["node_id"] == node_id:
-            io = EventLoopThread("state-probe")
-            try:
-                conn = io.run(connect(node["host"], node["port"], retries=2))
-                stats = io.run(conn.request("node_stats", {}))
-                io.run(conn.close())
-                return stats
-            except Exception:
-                return None
-            finally:
-                io.stop()
+            return _node_request(node, "node_stats")
     return None
 
 

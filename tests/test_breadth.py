@@ -1,0 +1,112 @@
+"""Breadth-item tests: TensorBoard logger, GBDT gating, stack dumps.
+
+Analog of ray: tune/tests/test_logger.py (TBX event files),
+train gbdt trainer construction errors, and `ray stack` (worker thread
+dumps via the dashboard reporter).
+"""
+
+import glob
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_tbx_logger_writes_event_files(ray_start_regular, tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    from ray_tpu import tune
+    from ray_tpu.air import RunConfig
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * i})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(storage_path=str(tmp_path), name="tbx"),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 0
+    events = glob.glob(
+        os.path.join(str(tmp_path), "tbx", "**", "events.out.tfevents.*"),
+        recursive=True,
+    )
+    assert len(events) >= 2, "expected one event file per trial"
+    # event files have content (scalars were written + flushed)
+    assert all(os.path.getsize(e) > 0 for e in events)
+
+
+def test_gbdt_trainers_gate_without_libs():
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+    have_xgb = True
+    try:
+        import xgboost  # noqa: F401
+    except ImportError:
+        have_xgb = False
+    if not have_xgb:
+        with pytest.raises(ImportError, match="xgboost"):
+            XGBoostTrainer(params={}, datasets={}, label_column="y")
+    have_lgbm = True
+    try:
+        import lightgbm  # noqa: F401
+    except ImportError:
+        have_lgbm = False
+    if not have_lgbm:
+        with pytest.raises(ImportError, match="lightgbm"):
+            LightGBMTrainer(params={}, datasets={}, label_column="y")
+
+
+@pytest.mark.skipif(
+    not os.environ.get("RAY_TPU_TEST_XGB"),
+    reason="xgboost not bundled in this image",
+)
+def test_xgboost_trainer_fits():  # pragma: no cover - gated
+    import numpy as np
+    import pandas as pd
+
+    from ray_tpu import data as rd
+    from ray_tpu.train import XGBoostTrainer
+
+    df = pd.DataFrame({"a": np.arange(100.0), "y": np.arange(100.0) * 2})
+    trainer = XGBoostTrainer(
+        params={"objective": "reg:squarederror"},
+        datasets={"train": rd.from_pandas(df)},
+        label_column="y",
+        num_boost_round=3,
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+
+
+def test_stack_dump(ray_start_regular):
+    import time
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5.0)
+        return 1
+
+    ref = slow.remote()
+    # poll until the worker is up and mid-task (cold spawn takes a moment)
+    deadline = time.time() + 20
+    workers = []
+    while time.time() < deadline:
+        stacks = state.get_stacks()
+        assert stacks and not stacks[0].get("error")
+        workers = stacks[0]["workers"]
+        if workers and any(w.get("current_task") for w in workers):
+            break
+        time.sleep(0.5)
+    assert workers, "no worker dumps returned"
+    text = "\n".join(
+        s for w in workers for s in w.get("threads", {}).values()
+    )
+    assert "time.sleep" in text or "sleep" in text
+    assert any(w.get("current_task") for w in workers)
+    assert ray_tpu.get(ref, timeout=60) == 1
