@@ -115,6 +115,20 @@ _flag("event_stats", bool, True)
 _flag("log_tail_interval_s", float, 0.3)
 # Push plane (ray: push_manager.h max_chunks_in_flight per push)
 _flag("push_max_chunks_in_flight", int, 8)
+_flag("push_rx_expiry_s", float, 60.0)  # abandoned inbound push sessions
+# Dispatch / scheduling cadence (raylet loops)
+_flag("dispatch_retry_interval_s", float, 0.01)
+_flag("infeasible_retry_interval_s", float, 0.5)
+_flag("pull_location_poll_interval_s", float, 0.1)
+_flag("actor_route_wait_alive_timeout_s", float, 30.0)
+# Driver-side get/wait cadence
+_flag("wait_poll_interval_s", float, 0.05)
+_flag("deferred_release_wait_s", float, 0.5)
+_flag("worker_dump_stacks_timeout_s", float, 10.0)
+# GCS scheduling retry cadence (actor placement / PG)
+_flag("gcs_schedule_retry_interval_s", float, 0.2)
+# Per-node dashboard agent (ray: dashboard/agent.py)
+_flag("enable_node_agent", bool, True)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)

@@ -221,7 +221,7 @@ class TaskExecutor:
                         ),
                     )
             else:
-                func = cloudpickle.loads(spec.func_blob)
+                func = self._load_fn(spec.func_blob)
                 if inspect.iscoroutinefunction(func):
                     self._ensure_user_loop()
                     cfut = asyncio.run_coroutine_threadsafe(
@@ -241,6 +241,25 @@ class TaskExecutor:
         finally:
             self.current_task_id = None
         return self._package_returns(spec, value, start)
+
+    def _load_fn(self, func_blob: bytes):
+        """Deserialize a task function with a digest-keyed cache: a driver
+        loop calling the same @remote function thousands of times must not
+        pay cloudpickle.loads per execution (ray parity: the function
+        table caches by function id in _raylet.pyx)."""
+        import hashlib
+
+        key = hashlib.md5(func_blob).digest()
+        cache = getattr(self, "_fn_cache", None)
+        if cache is None:
+            cache = self._fn_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cloudpickle.loads(func_blob)
+            if len(cache) >= 256:  # bound: long-lived workers, many jobs
+                cache.pop(next(iter(cache)))
+            cache[key] = fn
+        return fn
 
     @staticmethod
     def _invoke_traced(fn, ctx):

@@ -668,7 +668,7 @@ class GcsServer:
             target = pick_node(nodes, demand, strategy, None, rr,
                                cfg.scheduler_spread_threshold)
             if target is None or self.node_conns.get(target) is None:
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(cfg.gcs_schedule_retry_interval_s)
                 continue
             try:
                 reply = await self.node_conns[target].request(
@@ -676,7 +676,7 @@ class GcsServer:
                 )
             except Exception as e:
                 logger.warning("actor creation on %s failed: %s", target[:8], e)
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(cfg.gcs_schedule_retry_interval_s)
                 continue
             if reply.get("rejected"):
                 await asyncio.sleep(0.1)
@@ -805,7 +805,7 @@ class GcsServer:
             placed = await self._try_place_pg(pg)
             if placed:
                 return
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(cfg.gcs_schedule_retry_interval_s)
         if pg.state == "PENDING":
             pg.state = "INFEASIBLE"
             self._persist_pg(pg)

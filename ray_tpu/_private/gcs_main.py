@@ -24,6 +24,9 @@ async def amain(args):
 
 
 def main():
+    from ray_tpu._private.profiling import maybe_profile
+
+    maybe_profile("gcs")
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)

@@ -1,0 +1,52 @@
+"""Env-gated cProfile for system processes.
+
+Set ``RAY_TPU_PROFILE_DIR=/some/dir`` before starting a cluster and every
+system process (gcs, raylet, worker) profiles itself, dumping
+``<role>-<pid>.pstats`` on exit — the offline analog of attaching py-spy
+to the reference's C++ processes (which perf/gperftools would cover).
+Zero overhead when the variable is unset.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+
+
+def maybe_profile(role: str, snapshot_interval_s: float = 5.0):
+    """Enable process-wide profiling if RAY_TPU_PROFILE_DIR is set.
+
+    Stats snapshot to disk every few seconds (and at exit): system
+    processes die by SIGTERM→os._exit, which skips atexit hooks."""
+    out_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
+    if not out_dir:
+        return
+    import cProfile
+    import threading
+
+    prof = cProfile.Profile()
+    prof.enable()
+    path = os.path.join(out_dir, f"{role}-{os.getpid()}.pstats")
+
+    def dump():
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            prof.create_stats()  # NB: internally disables the profiler
+            prof.dump_stats(path)
+        except Exception:
+            pass
+        finally:
+            try:
+                prof.enable()
+            except Exception:
+                pass
+
+    def loop():
+        import time
+
+        while True:
+            time.sleep(snapshot_interval_s)
+            dump()
+
+    threading.Thread(target=loop, name="profile-snap", daemon=True).start()
+    atexit.register(dump)

@@ -250,8 +250,45 @@ class Raylet:
         self._tasks.append(
             asyncio.get_running_loop().create_task(self._log_tailer_loop())
         )
+        if cfg.enable_node_agent:
+            asyncio.get_running_loop().create_task(self._start_agent())
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
+
+    async def _start_agent(self):
+        """Spawn this node's dashboard agent (ray: agent_manager.h — a
+        per-node agent process serving node-local HTTP: stats, logs,
+        stacks). Its port registers in the GCS KV so the head/operators
+        can find it; failure is non-fatal (agents are observability)."""
+        from ray_tpu._private.node import package_env
+
+        port_file = os.path.join(
+            self.session_dir, f"agent_port_{self.node_id[:8]}"
+        )
+        try:
+            self.agent_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.dashboard.agent",
+                 "--raylet-port", str(self.port),
+                 "--session-dir", self.session_dir,
+                 "--port-file", port_file],
+                env=package_env(),
+                stdout=open(os.path.join(
+                    self.session_dir, "logs", f"agent_{self.node_id[:8]}.out"
+                ), "ab"),
+                stderr=subprocess.STDOUT,
+            )
+            for _ in range(100):  # aiohttp import can take a moment
+                if os.path.exists(port_file):
+                    break
+                await asyncio.sleep(0.1)
+            with open(port_file) as f:
+                self.agent_port = int(f.read().strip())
+            await self.gcs.request("kv_put", {
+                "ns": b"node_agents", "key": self.node_id.encode(),
+                "value": str(self.agent_port).encode(),
+            })
+        except Exception:
+            logger.warning("node agent failed to start", exc_info=True)
 
     # ------------------------------------------------------------------
     # worker-log streaming (ray: _private/log_monitor.py — the per-node
@@ -481,6 +518,12 @@ class Raylet:
         for w in list(self.all_workers.values()):
             try:
                 w.proc.terminate()
+            except Exception:
+                pass
+        agent = getattr(self, "agent_proc", None)
+        if agent is not None:
+            try:
+                agent.kill()
             except Exception:
                 pass
         await self.server.stop()
@@ -729,6 +772,17 @@ class Raylet:
         await self._schedule_or_queue(spec, depth=p.get("depth", 0))
         return {}
 
+    async def rpc_submit_batch(self, conn: Connection, p):
+        """Tick-batched submission: a driver flushing a burst sends ONE
+        frame with N specs instead of N request round trips (ray parity:
+        the core worker's task submission pipelining)."""
+        for spec in p["specs"]:
+            if spec.actor_id is not None and not spec.actor_creation:
+                await self.rpc_submit_task(conn, {"spec": spec})
+            else:
+                await self._schedule_or_queue(spec)
+        return {}
+
     async def _actor_router(self, actor_id: bytes):
         """Drain one actor's routing queue sequentially (delivery order =
         submission order; execution concurrency is the executor's business,
@@ -895,8 +949,20 @@ class Raylet:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
             again = deque()
+            # Scheduling-class gating (ray: scheduling_class in
+            # cluster_task_manager.cc): once one task of a (resources,
+            # name) class doesn't fit, every queued task of that class is
+            # skipped WITHOUT re-checking — a long homogeneous queue costs
+            # O(queue) appends, not O(queue) res_fits per wakeup (profiled
+            # at ~730 fits-checks per task before this gate).
+            blocked: set = set()
+            pool_exhausted = False
             while self.ready:
                 qt = self.ready.popleft()
+                cls = qt.spec.scheduling_class()
+                if pool_exhausted or cls in blocked:
+                    again.append(qt)
+                    continue
                 if not res_fits(qt.resources, self.resources_available):
                     # Infeasible on this node entirely: park it in the
                     # explicit infeasible queue — visible to the demand
@@ -906,10 +972,14 @@ class Raylet:
                     if not res_fits(qt.resources, self.resources_total):
                         self.infeasible[qt.spec.task_id] = qt
                     else:
+                        blocked.add(cls)
                         again.append(qt)
                     continue
                 w = await self._pop_worker(qt.spec)
                 if w is None:
+                    # worker-pool soft limit: a global condition — no
+                    # later task gets a worker this pass either
+                    pool_exhausted = True
                     again.append(qt)
                     continue
                 res_sub(self.resources_available, qt.resources)
@@ -920,7 +990,7 @@ class Raylet:
                 asyncio.get_running_loop().create_task(self._run_on_worker(qt, w))
             self.ready.extend(again)
             if again:
-                await asyncio.sleep(0.01)
+                await asyncio.sleep(cfg.dispatch_retry_interval_s)
                 self._dispatch_event.set()
 
     async def _infeasible_retry_loop(self):
@@ -929,7 +999,7 @@ class Raylet:
         bundle committed). A reschedule failure re-parks the task — one
         dying peer must not kill the loop or drop the task."""
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(cfg.infeasible_retry_interval_s)
             if not self.infeasible:
                 continue
             for tid, qt in list(self.infeasible.items()):
@@ -1087,16 +1157,34 @@ class Raylet:
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_host}:{self.gcs_port}"
         env["RAY_TPU_STORE_DIR"] = self.store_dir
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        if runtime_env and (runtime_env.get("working_dir_uri")
-                            or runtime_env.get("py_module_uris")):
-            # URIs the worker materializes before serving tasks
+        if runtime_env:
+            # ship every key except env_vars (already applied at spawn,
+            # above) so the worker's plugin registry — built-ins AND
+            # custom plugins — can materialize it before serving tasks
             # (ray: raylet -> runtime-env agent CreateRuntimeEnv).
             import json as _json
 
-            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps({
-                "working_dir_uri": runtime_env.get("working_dir_uri"),
-                "py_module_uris": runtime_env.get("py_module_uris"),
-            })
+            to_ship = {k: v for k, v in runtime_env.items()
+                       if k != "env_vars" and v is not None}
+            if to_ship:
+                try:
+                    env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(to_ship)
+                except TypeError:
+                    # defense in depth (the driver validates at option
+                    # time): a non-JSON value must not kill the dispatch
+                    # loop — ship the safe subset and log loudly
+                    safe = {}
+                    for k, v in to_ship.items():
+                        try:
+                            _json.dumps(v)
+                            safe[k] = v
+                        except TypeError:
+                            logger.error(
+                                "runtime_env[%r] is not JSON-serializable; "
+                                "dropped for worker spawn", k,
+                            )
+                    if safe:
+                        env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(safe)
         # Workers must not grab the TPU unless a task asks for it; JAX inits
         # lazily so this is safe, but keep workers on CPU by default for
         # control-plane work (the trainer backend overrides per worker group).
@@ -1173,7 +1261,9 @@ class Raylet:
         if addr is None or addr[0] == self.node_id:
             try:
                 table = await self.gcs.request(
-                    "wait_actor_alive", {"actor_id": spec.actor_id, "timeout": 30.0}
+                    "wait_actor_alive",
+                    {"actor_id": spec.actor_id,
+                     "timeout": cfg.actor_route_wait_alive_timeout_s}
                 )
             except Exception:
                 table = None
@@ -1309,7 +1399,7 @@ class Raylet:
                     return True
             if self.store.contains(oid):
                 return True
-            await asyncio.sleep(0.1)
+            await asyncio.sleep(cfg.pull_location_poll_interval_s)
         return False
 
     async def _fetch_from(self, peer: Connection, oid: ObjectID) -> bool:
@@ -1445,7 +1535,7 @@ class Raylet:
         """Drop abandoned assemblies (sender died mid-push) and return
         their byte charges to the transfer budget."""
         for k, st in list(self._push_rx.items()):
-            if now - st["ts"] > 60.0:
+            if now - st["ts"] > cfg.push_rx_expiry_s:
                 self._push_rx.pop(k, None)
                 self._pull_gate.uncharge(st["total"])
 
@@ -1674,7 +1764,10 @@ class Raylet:
 
         async def dump(w):
             try:
-                return await w.conn.request("dump_stacks", {}, timeout=10.0)
+                return await w.conn.request(
+                    "dump_stacks", {},
+                    timeout=cfg.worker_dump_stacks_timeout_s,
+                )
             except Exception:
                 return {"pid": w.proc.pid, "error": "unreachable"}
 

@@ -47,6 +47,9 @@ async def amain(args):
 
 
 def main():
+    from ray_tpu._private.profiling import maybe_profile
+
+    maybe_profile("raylet")
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs-host", default="127.0.0.1")
     parser.add_argument("--gcs-port", type=int, required=True)

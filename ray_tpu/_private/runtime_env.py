@@ -64,22 +64,90 @@ def package_directory(path: str) -> tuple:
     return digest, blob
 
 
-def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
-                        ) -> Optional[dict]:
-    """Driver-side: package local dirs, upload to the GCS KV, rewrite the
-    env to URI form (ray: upload_package_to_gcs). Idempotent on already-
-    prepared envs; validates unsupported plugins early."""
-    if not runtime_env:
-        return runtime_env
-    for unsupported in ("pip", "conda", "container"):
-        if runtime_env.get(unsupported):
+# ---------------------------------------------------------------------------
+# Plugin framework (ray parity: _private/runtime_env/plugin.py:24 —
+# RuntimeEnvPlugin with per-key validate/create hooks, priority-ordered).
+# The built-in keys (working_dir, py_modules, env_vars) are plugins of the
+# same registry user plugins join via register_runtime_env_plugin.
+# ---------------------------------------------------------------------------
+
+
+class RuntimeEnvPlugin:
+    """One runtime_env key's handling. ``validate`` runs driver-side at
+    option time (fail fast); ``prepare`` runs driver-side and may rewrite
+    the env dict (e.g. path -> URI); ``materialize`` runs in each worker
+    before it serves tasks."""
+
+    name: str = ""
+    priority: int = 50  # lower runs first (working_dir before py_modules)
+
+    def validate(self, env: dict) -> None:
+        pass
+
+    def prepare(self, core_worker, env: dict) -> None:
+        pass
+
+    def materialize(self, core_worker, env: dict) -> None:
+        pass
+
+
+class _UnsupportedPlugin(RuntimeEnvPlugin):
+    priority = 0  # reject before any packaging work
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def validate(self, env: dict) -> None:
+        if env.get(self.name):
             raise ValueError(
-                f"runtime_env[{unsupported!r}] is not supported in this "
+                f"runtime_env[{self.name!r}] is not supported in this "
                 "offline build (no package installation at task time); "
                 "bake dependencies into the image"
             )
-    env = dict(runtime_env)
 
+
+_PLUGINS: dict = {}
+
+
+def register_runtime_env_plugin(plugin: RuntimeEnvPlugin):
+    """Add a custom runtime_env key (ray parity: the plugin framework's
+    entry-point registration). The plugin's ``name`` is the env dict key
+    it owns."""
+    if not plugin.name:
+        raise ValueError("plugin needs a name (the runtime_env key it owns)")
+    _PLUGINS[plugin.name] = plugin
+
+
+def _ordered_plugins():
+    return sorted(_PLUGINS.values(), key=lambda p: p.priority)
+
+
+def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
+                        ) -> Optional[dict]:
+    """Driver-side: run every registered plugin's validate+prepare
+    (ray: upload_package_to_gcs and friends). Idempotent on already-
+    prepared envs; unsupported keys raise early."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    for plugin in _ordered_plugins():
+        plugin.validate(env)
+        plugin.prepare(core_worker, env)
+    # the raylet ships the env to workers as JSON; a non-JSON value (set,
+    # bytes, ...) must fail HERE at option time, not inside the raylet's
+    # dispatch loop
+    import json
+
+    try:
+        json.dumps({k: v for k, v in env.items() if k != "env_vars"})
+    except TypeError as e:
+        raise ValueError(
+            f"runtime_env values must be JSON-serializable: {e}"
+        ) from None
+    return env
+
+
+def _upload_factory(core_worker):
     def upload(path: str) -> str:
         # One walk+zip+upload per path per driver process: repeated
         # .remote() calls with the same working_dir must not re-hash the
@@ -101,15 +169,105 @@ def prepare_runtime_env(core_worker, runtime_env: Optional[dict]
         _UPLOAD_CACHE[cache_key] = digest
         return digest
 
-    if env.get("working_dir") and not env.get("working_dir_uri"):
-        env["working_dir_uri"] = upload(env.pop("working_dir"))
-    if env.get("py_modules") and not env.get("py_module_uris"):
-        uris = []
-        for mod_path in env.pop("py_modules"):
-            uris.append((os.path.basename(os.path.normpath(mod_path)),
-                         upload(mod_path)))
-        env["py_module_uris"] = uris
-    return env
+    return upload
+
+
+class _WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 10
+
+    def prepare(self, core_worker, env: dict) -> None:
+        if env.get("working_dir") and not env.get("working_dir_uri"):
+            upload = _upload_factory(core_worker)
+            env["working_dir_uri"] = upload(env.pop("working_dir"))
+
+    def materialize(self, core_worker, env: dict) -> None:
+        wd_uri = env.get("working_dir_uri")
+        if not wd_uri:
+            return
+        path = _fetch_and_extract(_gcs_requester(core_worker), wd_uri)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+
+
+class _PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 20
+
+    def prepare(self, core_worker, env: dict) -> None:
+        if env.get("py_modules") and not env.get("py_module_uris"):
+            upload = _upload_factory(core_worker)
+            uris = []
+            for mod_path in env.pop("py_modules"):
+                uris.append((os.path.basename(os.path.normpath(mod_path)),
+                             upload(mod_path)))
+            env["py_module_uris"] = uris
+
+    def materialize(self, core_worker, env: dict) -> None:
+        for name, uri in env.get("py_module_uris") or ():
+            path = _fetch_and_extract(_gcs_requester(core_worker), uri)
+            # extracted dir IS the module content; expose it under its name
+            parent = os.path.join(_cache_root(), f"mods_{uri}")
+            os.makedirs(parent, exist_ok=True)
+            link = os.path.join(parent, name)
+            if not os.path.exists(link):
+                try:
+                    os.symlink(path, link)
+                except OSError:
+                    pass
+            if parent not in sys.path:
+                sys.path.insert(0, parent)
+
+
+class _EnvVarsPlugin(RuntimeEnvPlugin):
+    """env_vars apply at worker SPAWN (the raylet exports them before the
+    interpreter starts, so sitecustomize/jax see them); this plugin only
+    validates shape."""
+
+    name = "env_vars"
+    priority = 5
+
+    def validate(self, env: dict) -> None:
+        ev = env.get("env_vars")
+        if ev is None:
+            return
+        if not isinstance(ev, dict) or not all(
+            isinstance(k, str) for k in ev
+        ):
+            raise ValueError("runtime_env['env_vars'] must be a str dict")
+
+
+for _name in ("pip", "conda", "container"):
+    register_runtime_env_plugin(_UnsupportedPlugin(_name))
+register_runtime_env_plugin(_EnvVarsPlugin())
+register_runtime_env_plugin(_WorkingDirPlugin())
+register_runtime_env_plugin(_PyModulesPlugin())
+
+
+def _load_env_plugins():
+    """Load plugin classes named in RAY_TPU_RUNTIME_ENV_PLUGINS
+    ("module:Class,module2:Class2") — the cross-process registration
+    path: workers are separate interpreters, so a plugin registered by
+    driver code alone would never materialize worker-side (ray parity:
+    the RAY_RUNTIME_ENV_PLUGINS class-path env var)."""
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        try:
+            mod_name, _, cls_name = item.partition(":")
+            import importlib
+
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            register_runtime_env_plugin(cls())
+        except Exception:  # a broken plugin must not kill every process
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "failed to load runtime_env plugin %r", item
+            )
+
+
+_load_env_plugins()
 
 
 def _cache_root() -> str:
@@ -139,33 +297,20 @@ def _fetch_and_extract(gcs_request, uri: str) -> str:
     return target
 
 
-def materialize(core_worker, runtime_env: Optional[dict]) -> None:
-    """Worker-side: download + extract this worker's env before it serves
-    tasks (ray: RuntimeEnvAgent.CreateRuntimeEnv). working_dir becomes the
-    process CWD and lands on sys.path; py_modules land on sys.path under
-    their original import names."""
-    if not runtime_env:
-        return
-
+def _gcs_requester(core_worker):
     def gcs_request(method, payload):
         return core_worker.io.run(core_worker.gcs.request(method, payload))
 
-    wd_uri = runtime_env.get("working_dir_uri")
-    if wd_uri:
-        path = _fetch_and_extract(gcs_request, wd_uri)
-        os.chdir(path)
-        if path not in sys.path:
-            sys.path.insert(0, path)
-    for name, uri in runtime_env.get("py_module_uris") or ():
-        path = _fetch_and_extract(gcs_request, uri)
-        # extracted dir IS the module content; expose it under its name
-        parent = os.path.join(_cache_root(), f"mods_{uri}")
-        os.makedirs(parent, exist_ok=True)
-        link = os.path.join(parent, name)
-        if not os.path.exists(link):
-            try:
-                os.symlink(path, link)
-            except OSError:
-                pass
-        if parent not in sys.path:
-            sys.path.insert(0, parent)
+    return gcs_request
+
+
+def materialize(core_worker, runtime_env: Optional[dict]) -> None:
+    """Worker-side: run every plugin's materialize before this worker
+    serves tasks (ray: RuntimeEnvAgent.CreateRuntimeEnv). working_dir
+    becomes the process CWD and lands on sys.path; py_modules land on
+    sys.path under their original import names; custom plugins run in
+    priority order."""
+    if not runtime_env:
+        return
+    for plugin in _ordered_plugins():
+        plugin.materialize(core_worker, runtime_env)

@@ -146,6 +146,13 @@ class CoreWorker:
         # Lock-free queue of ref releases deferred from ObjectRef.__del__
         # (GC can fire inside locked sections; see defer_ref_release).
         self._deferred_releases: deque = deque()
+        # woken by producers; a timed wait stays as the safety net so a
+        # set() lost to a race costs 0.5s, not forever (and the idle drain
+        # thread no longer wakes 50x/s on every process)
+        self._release_event = threading.Event()
+        # tick-batched task submission buffer (see _submit_when_ready)
+        self._submit_buf: List[TaskSpec] = []
+        self._submit_flushing = False
         threading.Thread(
             target=self._release_drain_loop,
             name=f"ref-release-{self.client_id[:6]}", daemon=True,
@@ -247,10 +254,33 @@ class CoreWorker:
         spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
         with self._lock:
             self._task_arg_pins[spec.task_id] = pins
+        # Tick-batched submission: a burst of .remote() calls lands on the
+        # io loop as many _submit_when_ready tasks in the same tick; buffer
+        # them and ship ONE submit_batch frame (same discipline as the
+        # GCS pubsub outbox). Actor tasks keep the direct path — their
+        # per-actor FIFO relies on frame-arrival order per submission.
+        if spec.actor_id is not None and not spec.actor_creation:
+            try:
+                await self.raylet.request("submit_task", {"spec": spec})
+            except Exception as e:
+                self._fail_returns(spec, f"task submission failed: {e}")
+            return
+        self._submit_buf.append(spec)
+        if not self._submit_flushing:
+            self._submit_flushing = True
+            asyncio.get_running_loop().create_task(self._flush_submits())
+
+    async def _flush_submits(self):
+        await asyncio.sleep(0)  # one tick: let same-burst submissions land
+        batch, self._submit_buf = self._submit_buf, []
+        self._submit_flushing = False
+        if not batch:
+            return
         try:
-            await self.raylet.request("submit_task", {"spec": spec})
+            await self.raylet.request("submit_batch", {"specs": batch})
         except Exception as e:
-            self._fail_returns(spec, f"task submission failed: {e}")
+            for spec in batch:
+                self._fail_returns(spec, f"task submission failed: {e}")
 
     def _release_task_pins(self, task_id: bytes):
         with self._lock:
@@ -1110,7 +1140,7 @@ class CoreWorker:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 break
-            time.sleep(0.05)
+            time.sleep(cfg.wait_poll_interval_s)
         ordered_ready = [r for r in refs if r in ready][:num_returns]
         picked = set(ordered_ready)
         return ordered_ready, [r for r in refs if r not in picked]
@@ -1163,13 +1193,17 @@ class CoreWorker:
         interpreter is mid-way through a locked core-worker section. The
         release-drain thread applies the actual decrement."""
         self._deferred_releases.append(ref_binary)
+        self._release_event.set()
 
     def _release_drain_loop(self):
         while getattr(self, "connected", True):
             try:
                 oid = self._deferred_releases.popleft()
             except IndexError:
-                time.sleep(0.02)
+                self._release_event.clear()
+                if self._deferred_releases:  # raced a producer's append
+                    continue
+                self._release_event.wait(timeout=cfg.deferred_release_wait_s)
                 continue
             try:
                 self.remove_local_ref(oid)

@@ -12,6 +12,9 @@ from ray_tpu._private.jax_pin import _pin_jax_platform_on_import
 
 
 def main():
+    from ray_tpu._private.profiling import maybe_profile
+
+    maybe_profile("worker")
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format=f"[worker pid={os.getpid()}] %(levelname)s %(name)s: %(message)s",

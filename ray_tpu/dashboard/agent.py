@@ -1,0 +1,123 @@
+"""Per-node dashboard agent.
+
+ray parity: dashboard/agent.py (the per-node agent process serving
+node-local HTTP: stats, log listing/tailing, profiling) — one agent
+subprocess per raylet, spawned and owned by it. Node-local data never
+transits the head: operators (or the dashboard head acting as a proxy)
+hit the agent directly.
+
+Routes:
+  GET /api/v0/node    — node stats (via the local raylet's node_stats RPC)
+  GET /api/v0/stacks  — local workers' thread dumps
+  GET /api/v0/logs    — session log files (name, size)
+  GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Optional
+
+
+def _json(payload, status=200):
+    from aiohttp import web
+
+    return web.Response(
+        text=json.dumps(payload, default=str),
+        content_type="application/json", status=status,
+    )
+
+
+class Agent:
+    def __init__(self, raylet_port: int, session_dir: str):
+        self.raylet_port = raylet_port
+        self.session_dir = session_dir
+        self._conn = None
+
+    async def _raylet(self):
+        if self._conn is None or self._conn.closed:
+            from ray_tpu._private.rpcio import connect
+
+            self._conn = await connect("127.0.0.1", self.raylet_port)
+        return self._conn
+
+    async def node(self, request):
+        conn = await self._raylet()
+        return _json(await conn.request("node_stats", {}, timeout=30))
+
+    async def stacks(self, request):
+        conn = await self._raylet()
+        return _json(await conn.request("node_stacks", {}, timeout=30))
+
+    async def logs(self, request):
+        log_dir = os.path.join(self.session_dir, "logs")
+        out = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                full = os.path.join(log_dir, name)
+                if os.path.isfile(full):
+                    out.append({"file": name, "bytes": os.path.getsize(full)})
+        except OSError:
+            pass
+        return _json(out)
+
+    async def tail(self, request):
+        name = request.query.get("file", "")
+        try:
+            lines = int(request.query.get("lines", "100"))
+        except ValueError:
+            return _json({"error": "lines must be an integer"}, status=400)
+        if "/" in name or name.startswith("."):
+            return _json({"error": "bad file name"}, status=400)
+        path = os.path.join(self.session_dir, "logs", name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                text = f.read().decode("utf-8", "replace")
+        except OSError:
+            return _json({"error": "no such log"}, status=404)
+        return _json({"file": name,
+                      "lines": text.splitlines()[-lines:]})
+
+
+async def amain(args) -> None:
+    from aiohttp import web
+
+    agent = Agent(args.raylet_port, args.session_dir)
+    app = web.Application()
+    app.router.add_get("/api/v0/node", agent.node)
+    app.router.add_get("/api/v0/stacks", agent.stacks)
+    app.router.add_get("/api/v0/logs", agent.logs)
+    app.router.add_get("/api/v0/logs/tail", agent.tail)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", args.port)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, args.port_file)
+    # park; the owning raylet kills us on shutdown
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main(argv: Optional[list] = None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Custom runtime-env plugin used by tests/test_runtime_env_plugins.py."""
+
+import os
+
+from ray_tpu._private.runtime_env import RuntimeEnvPlugin
+
+
+class MarkerPlugin(RuntimeEnvPlugin):
+    """Materializes runtime_env["marker"] as an env var in the worker."""
+
+    name = "marker"
+    priority = 40
+
+    def validate(self, env):
+        m = env.get("marker")
+        if m is not None and not isinstance(m, str):
+            raise ValueError("marker must be a string")
+
+    def materialize(self, core_worker, env):
+        if env.get("marker"):
+            os.environ["RTPU_TEST_MARKER"] = env["marker"]

@@ -1,0 +1,79 @@
+"""Runtime-env plugin framework tests.
+
+Analog of ray: python/ray/tests/test_runtime_env_plugin.py — custom
+plugins register via the class-path env var, validate at option time,
+and materialize inside worker processes; built-in keys ride the same
+registry; unsupported keys still fail fast.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_custom_plugin_materializes_in_worker(monkeypatch):
+    monkeypatch.setenv(
+        "RAY_TPU_RUNTIME_ENV_PLUGINS",
+        "tests.runtime_env_plugin_mod:MarkerPlugin",
+    )
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"marker": "hello-plugin"})
+        def read_marker():
+            return os.environ.get("RTPU_TEST_MARKER")
+
+        assert ray_tpu.get(read_marker.remote(), timeout=60) == "hello-plugin"
+
+        # a worker of a DIFFERENT env (no marker) must not see it
+        @ray_tpu.remote
+        def read_plain():
+            return os.environ.get("RTPU_TEST_MARKER")
+
+        assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_plugin_validate_fails_fast():
+    from ray_tpu._private.runtime_env import (
+        RuntimeEnvPlugin,
+        prepare_runtime_env,
+        register_runtime_env_plugin,
+    )
+
+    class Picky(RuntimeEnvPlugin):
+        name = "picky"
+
+        def validate(self, env):
+            if env.get("picky") == "bad":
+                raise ValueError("picky rejects bad")
+
+    register_runtime_env_plugin(Picky())
+    with pytest.raises(ValueError, match="picky rejects bad"):
+        prepare_runtime_env(None, {"picky": "bad"})
+    # good values pass through untouched
+    assert prepare_runtime_env(None, {"picky": "good"})["picky"] == "good"
+
+
+def test_unsupported_keys_still_raise():
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    for key in ("pip", "conda", "container"):
+        with pytest.raises(ValueError, match="not supported"):
+            prepare_runtime_env(None, {key: ["anything"]})
+
+
+def test_non_json_value_rejected_at_option_time():
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    with pytest.raises(ValueError, match="JSON-serializable"):
+        prepare_runtime_env(None, {"custom_blob": {1, 2}})
+
+
+def test_env_vars_shape_validated():
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    with pytest.raises(ValueError, match="env_vars"):
+        prepare_runtime_env(None, {"env_vars": ["not", "a", "dict"]})

@@ -1,0 +1,71 @@
+"""Dask scheduler shim + Grafana factory tests.
+
+Analog of ray: python/ray/util/dask tests (graphs execute on the cluster
+with inter-task edges as objects) and the grafana_dashboard_factory
+output-shape tests.
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import ray_dask_get
+
+
+def _add(a, b):
+    return a + b
+
+
+def _inc(x):
+    return x + 1
+
+
+def test_dask_graph_executes(ray_start_regular):
+    dsk = {
+        "a": 1,
+        "b": (_inc, "a"),          # 2
+        "c": (_inc, "b"),          # 3
+        "d": (_add, "b", "c"),     # 5
+        "e": (_add, "d", 10),      # 15
+    }
+    assert ray_dask_get(dsk, "e") == 15
+    assert ray_dask_get(dsk, ["b", "d", "e"]) == [2, 5, 15]
+
+
+def test_dask_nested_lists_and_tasks(ray_start_regular):
+    dsk = {
+        "xs": [1, 2, 3],
+        "sum": (sum, "xs"),
+        "both": (_add, (_inc, 4), "sum"),  # inline nested task: 5 + 6
+    }
+    assert ray_dask_get(dsk, "both") == 11
+
+
+def test_dask_nested_task_with_key_args(ray_start_regular):
+    dsk = {
+        "a": (_inc, 1),                    # 2
+        "b": (_add, (_inc, "a"), 1),       # nested task referencing a key
+        "lst": ["a"],                      # list-of-keys graph value
+    }
+    assert ray_dask_get(dsk, "b") == 4
+    assert ray_dask_get(dsk, "lst") == [2]
+
+
+def test_dask_cycle_detected(ray_start_regular):
+    dsk = {"a": (_inc, "b"), "b": (_inc, "a")}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "a")
+
+
+def test_grafana_dashboard_shape(tmp_path):
+    from ray_tpu.dashboard.grafana import generate_dashboard, write_dashboard
+
+    dash = generate_dashboard(user_metrics=["my_app_requests_total"])
+    assert dash["uid"] == "ray-tpu-cluster"
+    exprs = [p["targets"][0]["expr"] for p in dash["panels"]]
+    assert "ray_tpu_node_count" in exprs
+    assert "my_app_requests_total" in exprs
+    path = write_dashboard(str(tmp_path / "dash.json"))
+    loaded = json.load(open(path))
+    assert loaded["panels"]
