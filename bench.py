@@ -8,17 +8,52 @@ commonly reported ~38-40% MFU for this model does ~0.9 GFLOP/token effective
 -> ~130k tokens/s/chip; the 90% bar is therefore ~117k tokens/s/chip.
 vs_baseline = measured / 117_000 (>=1.0 beats the target).
 
-The bench sweeps (batch_size, remat) configurations — the VERDICT r1 levers:
-8x1024 tokens/step with remat off left the MXU idle — measuring each with a
-short timed run (OOM-safe), then reports the best. Sweep details go to
-stderr; stdout stays the single JSON line.
+Hard invariant (round-3 postmortem, rc=124 / parsed:null): this script MUST
+emit its JSON line no matter what. A wall-clock watchdog (BENCH_BUDGET_S,
+default 420s) fires SIGALRM and prints the best result so far; SIGTERM (the
+driver's `timeout` grace signal) does the same. The TPU probe is a single
+bounded subprocess attempt — a dead tunnel blocks inside the PJRT client
+where no in-process timeout can reach, so the probe must never run in-process
+and must never retry-loop past the budget.
+
+The sweep is ordered most-promising-first so a watchdog exit still records
+the best known configuration.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
 import sys
+import threading
 import time
+
+_DEADLINE = time.monotonic() + float(os.environ.get("BENCH_BUDGET_S", "420"))
+_BASELINE = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
+
+# Best-so-far record; the watchdog prints exactly this. Starts as a degraded
+# placeholder so even a hang inside jax import/compile yields a parseable line.
+_record = {
+    "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "tokens/s/chip",
+    "vs_baseline": 0.0,
+    "degraded": "no_measurement_completed",
+}
+_printed = False
+
+
+def _emit_and_exit(signum=None, frame=None):
+    global _printed
+    if not _printed:
+        _printed = True
+        print(json.dumps(_record), flush=True)
+    os._exit(0)
+
+
+def _remaining() -> float:
+    return _DEADLINE - time.monotonic()
 
 
 def _measure(config_cls, batch_size, seq_len, remat, steps, warmup,
@@ -50,67 +85,84 @@ def _measure(config_cls, batch_size, seq_len, remat, steps, warmup,
     return batch_size * seq_len * steps / dt
 
 
-def _tpu_reachable(timeout_s: float = 150.0, attempts: int = 3,
-                   retry_wait_s: float = 60.0) -> bool:
-    """Probe the accelerator in a subprocess: a dead TPU tunnel makes
-    jax.devices() block indefinitely inside the PJRT client, which no
-    in-process timeout can interrupt. The tunnel flaps, so a failed probe
-    retries a couple of times before falling back to the CPU smoke bench
-    (a CPU number is ~0.03x and useless as a round record)."""
+def _tpu_reachable(timeout_s: float = 75.0) -> bool:
+    """One bounded out-of-process probe. A dead axon tunnel makes
+    jax.devices() block indefinitely inside the PJRT client; retry loops are
+    what blew the round-3 budget, so exactly one attempt."""
     import subprocess
 
-    for attempt in range(attempts):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, timeout=timeout_s, text=True,
-            )
-        except subprocess.TimeoutExpired:
-            print(f"[bench] TPU probe {attempt + 1}/{attempts} timed out",
-                  file=sys.stderr)
-        else:
-            platform = (out.stdout or "").strip().splitlines()[-1:] or [""]
-            if out.returncode == 0 and platform[0] not in ("", "cpu"):
-                return True
-            print(f"[bench] TPU probe {attempt + 1}/{attempts} failed "
-                  f"(rc={out.returncode}, platform={platform[0]!r})",
-                  file=sys.stderr)
-        if attempt + 1 < attempts:
-            time.sleep(retry_wait_s)
-    print("[bench] TPU unreachable; falling back to CPU", file=sys.stderr)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=min(timeout_s, max(_remaining() - 60, 5)),
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("[bench] TPU probe timed out", file=sys.stderr)
+        return False
+    platform = (out.stdout or "").strip().splitlines()[-1:] or [""]
+    if out.returncode == 0 and platform[0] not in ("", "cpu"):
+        return True
+    print(f"[bench] TPU probe failed (rc={out.returncode}, "
+          f"platform={platform[0]!r})", file=sys.stderr)
     return False
 
 
+def _watchdog_thread():
+    """Signal handlers only run between bytecodes on the MAIN thread — if
+    the tunnel drops while _measure blocks inside the PJRT client, SIGALRM
+    would set a flag that never executes. A daemon thread is immune to that:
+    it wakes at the deadline, prints the best record, and hard-exits."""
+    while _remaining() > 0:
+        time.sleep(min(_remaining(), 5))
+    _emit_and_exit()
+
+
 def main():
+    signal.signal(signal.SIGTERM, _emit_and_exit)
+    threading.Thread(target=_watchdog_thread, daemon=True).start()
+
+    on_tpu = _tpu_reachable()
+
+    if not on_tpu:
+        # both layers matter: sitecustomize already imported jax with
+        # JAX_PLATFORMS=axon frozen in, so the config must be updated too —
+        # and backend discovery reads the env var (env alone leaves the
+        # frozen config pointing at the dead tunnel and hangs)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
 
-    if not _tpu_reachable():
+    if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
 
     from ray_tpu.models import gpt2
 
-    devices = jax.devices()
-    on_tpu = devices[0].platform != "cpu"
     if on_tpu:
         seq_len, steps, warmup = 1024, 10, 3
         config_cls = gpt2.GPT2Config.gpt2_124m
-        # (batch, remat, attention): r1 shipped (8, False, auto) at 0.665x;
-        # remat + larger batch is the standard MFU lever on a 16GB v5e
-        # chip, and the in-repo Pallas flash kernel gets a trial against
-        # the backend's fused attention.
+        # Ordered most-promising-first (r1 shipped (8, False, auto) at
+        # 0.665x; remat + larger batch is the standard MFU lever on a 16GB
+        # v5e chip; the in-repo Pallas flash kernel gets a trial against the
+        # backend's fused attention).
         sweep = [
-            (8, False, "auto"), (16, False, "auto"), (16, True, "auto"),
             (32, True, "auto"), (64, True, "auto"), (32, True, "flash"),
+            (16, True, "auto"), (16, False, "auto"), (8, False, "auto"),
         ]
     else:  # CPU smoke fallback so the bench always emits a line
         seq_len, steps, warmup = 128, 3, 1
         config_cls = gpt2.GPT2Config.small_test
         sweep = [(2, False, "auto")]
+        _record["degraded"] = "tpu_unreachable_cpu_smoke"
 
-    best = 0.0
-    best_cfg = sweep[0]
     for batch_size, remat, attention in sweep:
+        # Leave headroom for compile (~30-60s through the tunnel) + 10 timed
+        # steps; starting a config we cannot finish wastes the watchdog exit.
+        if _record["value"] > 0 and _remaining() < 90:
+            print(f"[bench] budget low ({_remaining():.0f}s); stopping sweep",
+                  file=sys.stderr)
+            break
         try:
             tps = _measure(config_cls, batch_size, seq_len, remat, steps,
                            warmup, attention=attention)
@@ -120,23 +172,17 @@ def main():
             continue
         print(f"[bench] batch={batch_size} remat={remat} "
               f"attn={attention}: {tps:,.0f} tok/s", file=sys.stderr)
-        if tps > best:
-            best, best_cfg = tps, (batch_size, remat, attention)
+        if tps > _record["value"]:
+            _record.update(
+                value=round(tps, 1),
+                vs_baseline=round(tps / _BASELINE, 4),
+                config={"batch_size": batch_size, "remat": remat,
+                        "attention": attention, "seq_len": seq_len},
+            )
+            if on_tpu:
+                _record.pop("degraded", None)
 
-    baseline = 117_000.0  # 90% of estimated A100 DDP per-chip tokens/s
-    record = {
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
-        "value": round(best, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(best / baseline, 4),
-        "config": {"batch_size": best_cfg[0], "remat": best_cfg[1],
-                   "attention": best_cfg[2], "seq_len": seq_len},
-    }
-    if not on_tpu:
-        # CPU smoke numbers are not comparable to the TPU baseline; mark
-        # the record so a dead tunnel is not read as a perf regression
-        record["degraded"] = "tpu_unreachable_cpu_smoke"
-    print(json.dumps(record))
+    _emit_and_exit()
 
 
 if __name__ == "__main__":

@@ -302,6 +302,8 @@ class Raylet:
         if not w.log_path:
             return None
         lines_out = []
+        budget = 2 * 1024 * 1024  # per-tick cap: keeps a chatty worker from
+        # monopolizing the tick without letting it lag unboundedly behind
         try:
             with open(w.log_path, "rb") as f:
                 f.seek(w.log_offset)
@@ -313,7 +315,8 @@ class Raylet:
                     data = w.log_partial + chunk
                     *lines, w.log_partial = data.split(b"\n")
                     lines_out.extend(lines)
-                    if not final:
+                    budget -= len(chunk)
+                    if not final and budget <= 0:
                         break  # bounded per tick; the next tick continues
         except OSError:
             return None
@@ -1491,6 +1494,7 @@ class Raylet:
             )
 
             failed = [False]
+            landed = [False]  # receiver confirmed the object is in its store
 
             async def send(payload):
                 try:
@@ -1498,6 +1502,8 @@ class Raylet:
                         "push_chunks", payload, timeout=cfg.gcs_rpc_timeout_s
                     )
                     ok = bool(reply.get("ok") or reply.get("have"))
+                    if reply.get("assembled") or reply.get("have"):
+                        landed[0] = True
                 except Exception:
                     ok = False
                 finally:
@@ -1527,7 +1533,11 @@ class Raylet:
                     break
             results = await asyncio.gather(*sends, return_exceptions=True)
             sent_all = off >= total and not failed[0]
-            return sent_all and all(r is True for r in results)
+            # success requires an explicit landing ack (assembled / have):
+            # per-chunk acks alone can all succeed while the receiver's
+            # session expired mid-push and the object never materialized
+            return (sent_all and all(r is True for r in results)
+                    and landed[0])
         finally:
             buf.release()
 
@@ -1547,6 +1557,13 @@ class Raylet:
         here backpressures the sender through its chunk pipeline."""
         oid = ObjectID(p["object_id"])
         if self.store.contains(oid):
+            # drop any in-progress assembly of this object (e.g. a slower
+            # concurrent push) and return its pull-gate byte charge now
+            # rather than stranding it until the expiry sweep
+            for k, st in list(self._push_rx.items()):
+                if k[0] == oid.binary():
+                    self._push_rx.pop(k, None)
+                    self._pull_gate.uncharge(st["total"])
             return {"have": True}
         now = time.monotonic()
         self._expire_push_rx(now)
@@ -1590,6 +1607,7 @@ class Raylet:
             except Exception:
                 pass
             self._dispatch_event.set()
+            return {"ok": True, "assembled": True}
         return {"ok": True}
 
     async def rpc_push_object(self, conn: Connection, p):

@@ -9,8 +9,11 @@ dashboard works with no extra agent.
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Dict, List
+
+logger = logging.getLogger(__name__)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -61,6 +64,16 @@ def render_metrics(records: Dict[str, List[dict]]) -> str:
                         "buckets": [0] * len(s["buckets"]),
                         "sum": 0.0, "count": 0,
                     })
+                    if tuple(s["boundaries"]) != tuple(m["boundaries"]):
+                        # summing bucket counts by index across differently
+                        # bucketed declarations silently corrupts the merge,
+                        # and emitting both would duplicate the labelset and
+                        # invalidate the whole exposition — drop the
+                        # mismatched dump and keep the endpoint scrapeable
+                        logger.warning(
+                            "histogram %s: conflicting bucket boundaries "
+                            "across processes; dropping one dump", name)
+                        continue
                     for i, c in enumerate(s["buckets"]):
                         m["buckets"][i] += c
                     m["sum"] += s["sum"]

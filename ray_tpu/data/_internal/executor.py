@@ -322,7 +322,9 @@ def execute_streaming(plan: L.LogicalPlan,
 
     def finalize():
         try:
-            yield from out
+            for bundle in out:
+                stats.record_yield(bundle[1])
+                yield bundle
         finally:
             stats.finalize()
 

@@ -66,6 +66,14 @@ class ExecStats:
         self.ops: List[OpStats] = []
         self._t0 = time.perf_counter()
         self.total_s: Optional[float] = None
+        # actual yielded plan output, counted by the executor; ops[-1] is
+        # wrong when the final stage (Limit/Zip/Union) records no OpStats
+        self.out_rows: Optional[int] = None
+        self.out_bytes: Optional[int] = None
+
+    def record_yield(self, meta):
+        self.out_rows = (self.out_rows or 0) + (meta.num_rows or 0)
+        self.out_bytes = (self.out_bytes or 0) + (meta.size_bytes or 0)
 
     def op(self, name: str) -> OpStats:
         st = OpStats(name)
@@ -82,8 +90,11 @@ class ExecStats:
         self.finalize()
         lines = ["Execution stats:"]
         lines.extend(op.summary_row() for op in self.ops)
-        rows = self.ops[-1].num_rows if self.ops else 0
-        out_bytes = self.ops[-1].output_bytes if self.ops else 0
+        if self.out_rows is not None:
+            rows, out_bytes = self.out_rows, self.out_bytes or 0
+        else:
+            rows = self.ops[-1].num_rows if self.ops else 0
+            out_bytes = self.ops[-1].output_bytes if self.ops else 0
         lines.append(
             f"Total: {self.total_s:.2f}s, output {rows} rows "
             f"({_fmt_bytes(out_bytes)})"

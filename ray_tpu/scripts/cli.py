@@ -74,9 +74,13 @@ def cmd_start(args):
         print("connect drivers with "
               f"ray_tpu.init(address=\"{node.address}\")")
         if node.token_file:
-            token = os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
-            print("to join from another machine, first run:\n"
-                  f"  export RAY_TPU_CLUSTER_TOKEN={token}")
+            # never print the token itself: it would persist in terminal
+            # scrollback / CI logs and weaken the bearer-token posture
+            print("to join from another machine, copy the contents of\n"
+                  f"  {node.token_file}\n"
+                  "(on this head node) into RAY_TPU_CLUSTER_TOKEN there; "
+                  "on this machine:\n"
+                  f"  export RAY_TPU_CLUSTER_TOKEN=$(cat {node.token_file})")
     else:
         address = _resolve_address(args)
         host, port = address.rsplit(":", 1)
