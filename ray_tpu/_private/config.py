@@ -116,6 +116,12 @@ _flag("log_tail_interval_s", float, 0.3)
 # Push plane (ray: push_manager.h max_chunks_in_flight per push)
 _flag("push_max_chunks_in_flight", int, 8)
 _flag("push_rx_expiry_s", float, 60.0)  # abandoned inbound push sessions
+# Direct task push over worker leases (ray: direct_task_transport.cc)
+_flag("direct_task_leases", bool, True)
+_flag("direct_lease_pipeline_depth", int, 4)  # in-flight tasks per lease
+_flag("direct_lease_max", int, 16)  # leases per scheduling class per driver
+_flag("direct_lease_linger_s", float, 0.5)  # idle hold before lease return
+_flag("direct_actor_calls", bool, True)  # push actor calls to the worker
 # Dispatch / scheduling cadence (raylet loops)
 _flag("dispatch_retry_interval_s", float, 0.01)
 _flag("infeasible_retry_interval_s", float, 0.5)

@@ -43,6 +43,9 @@ class ActorRecord:
         self.state = PENDING_CREATION
         self.node_id: Optional[str] = None
         self.address: Optional[tuple] = None  # (node_id_hex, worker_client_id)
+        # (host, port) of the actor worker's own RPC server; drivers push
+        # calls straight there (ray: direct actor call transport)
+        self.direct_addr: Optional[tuple] = None
         self.num_restarts = 0
         self.name = spec.name_registered
         self.namespace = spec.namespace or "default"
@@ -57,6 +60,7 @@ class ActorRecord:
             "state": self.state,
             "node_id": self.node_id,
             "address": self.address,
+            "direct_addr": self.direct_addr,
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
             "owner_conn_key": self.owner_conn_key,
@@ -68,6 +72,7 @@ class ActorRecord:
         rec.state = d["state"]
         rec.node_id = d["node_id"]
         rec.address = tuple(d["address"]) if d["address"] else None
+        rec.direct_addr = tuple(d["direct_addr"]) if d.get("direct_addr") else None
         rec.num_restarts = d["num_restarts"]
         rec.death_cause = d["death_cause"]
         rec.owner_conn_key = d.get("owner_conn_key")
@@ -79,6 +84,7 @@ class ActorRecord:
             "state": self.state,
             "node_id": self.node_id,
             "address": self.address,
+            "direct_addr": self.direct_addr,
             "name": self.name,
             "namespace": self.namespace,
             "num_restarts": self.num_restarts,
@@ -321,6 +327,9 @@ class GcsServer:
             if rec is not None and rec.state != DEAD:
                 rec.node_id = node_id
                 rec.address = (node_id, client_id)
+                # re-registered after GCS restart: the direct endpoint is
+                # unknown here; drivers fall back to raylet routing
+                rec.direct_addr = None
                 rec.state = ALIVE
                 self._recovering.discard(actor_id)
                 await self._publish_actor(rec)
@@ -688,6 +697,7 @@ class GcsServer:
                 return
             rec.node_id = target
             rec.address = (target, reply["worker_client_id"])
+            rec.direct_addr = tuple(reply["direct_addr"]) if reply.get("direct_addr") else None
             rec.state = ALIVE
             await self._publish_actor(rec)
             return
@@ -754,6 +764,7 @@ class GcsServer:
             rec.state = RESTARTING
             rec.node_id = None
             rec.address = None
+            rec.direct_addr = None
             await self._publish_actor(rec)
             await asyncio.sleep(cfg.actor_restart_delay_ms / 1000.0)
             asyncio.get_running_loop().create_task(self._schedule_actor(rec))

@@ -94,6 +94,10 @@ class Connection:
         self._pending: Dict[int, asyncio.Future] = {}
         self._msg_ids = itertools.count(1)
         self._send_lock = asyncio.Lock()
+        # tick-coalesced writes: frames queued in order, one flush task
+        # joins small frames into a single socket write per loop tick
+        self._wbuf: list = []
+        self._wflush: Optional[asyncio.Task] = None
         self._closed = False
         self.on_close: Optional[Callable] = None
         self._recv_task: Optional[asyncio.Task] = None
@@ -104,11 +108,72 @@ class Connection:
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
         return self._recv_task
 
+    def _enqueue_frame(self, frame: bytes) -> asyncio.Task:
+        """Queue a frame synchronously (caller order = wire order) and
+        return the shared flush task."""
+        self._wbuf.append(frame)
+        if self._wflush is None or self._wflush.done():
+            self._wflush = asyncio.get_running_loop().create_task(
+                self._flush_writes()
+            )
+        return self._wflush
+
     async def _send(self, msg_id: int, kind: int, method: str, payload):
         data = pickle.dumps((msg_id, kind, method, payload), protocol=5)
+        flush = self._enqueue_frame(len(data).to_bytes(_HDR, "little") + data)
+        # await the shared flush so callers keep drain() backpressure;
+        # shield: one canceled sender must not kill everyone's flush
+        await asyncio.shield(flush)
+
+    def request_nowait(self, method: str, payload=None) -> asyncio.Future:
+        """Enqueue a request frame SYNCHRONOUSLY and return the response
+        future. Two request_nowait calls from the same tick hit the wire
+        in call order — the ordered-pipelining primitive direct actor
+        calls ride on (a plain ``await request()`` per call would
+        serialize to one call per RTT or lose ordering across tasks)."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        fut.add_done_callback(lambda _f: self._pending.pop(msg_id, None))
+        data = pickle.dumps((msg_id, KIND_REQ, method, payload), protocol=5)
+        self._enqueue_frame(len(data).to_bytes(_HDR, "little") + data)
+        return fut
+
+    async def _flush_writes(self):
+        """Write every queued frame with ONE socket write per tick (frames
+        stay in queue order — actor-call ordering rides on it). asyncio's
+        transport issues a send syscall per write() when its buffer is
+        empty, so a burst of small control frames written individually
+        costs a syscall + receiver wakeup each; joined, the burst is one
+        syscall and the peer's recv loop drains it in one poll."""
+        # No deliberate delay: create_task() already defers this past the
+        # currently-running callback, so a burst sent from one handler
+        # coalesces — while a sequential request chain only pays task
+        # scheduling, not a full extra loop tick per RPC.
         async with self._send_lock:
-            self.writer.write(len(data).to_bytes(_HDR, "little") + data)
-            await self.writer.drain()
+            # loop until drained: frames appended while we're suspended in
+            # drain() ride THIS task — a sender that sees the task not done
+            # won't start another, so leaving them would stall delivery
+            while self._wbuf and not self._closed:
+                buf, self._wbuf = self._wbuf, []
+                run: list = []
+                for frame in buf:
+                    if len(frame) > 128 * 1024:
+                        # big frame (object chunk): joining would memcpy
+                        # MBs — flush the small run, then write it unjoined
+                        if run:
+                            self.writer.write(b"".join(run))
+                            run = []
+                        self.writer.write(frame)
+                    else:
+                        run.append(frame)
+                if run:
+                    self.writer.write(
+                        run[0] if len(run) == 1 else b"".join(run)
+                    )
+                await self.writer.drain()
 
     async def request(self, method: str, payload=None, timeout: float = None) -> Any:
         if self._closed:

@@ -31,7 +31,8 @@ from ray_tpu._private.common import SchedulingStrategy, TaskSpec, rewrite_resour
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.rpcio import Connection, EventLoopThread, connect
+from ray_tpu._private.rpcio import (Connection, EventLoopThread, RpcServer,
+                                    connect)
 
 logger = logging.getLogger(__name__)
 
@@ -117,12 +118,24 @@ class CoreWorker:
                  "is_driver": is_driver},
             )
         )
+        # Workers serve a direct RPC endpoint so drivers holding a lease
+        # push tasks straight here, skipping the raylet per task (ray:
+        # core worker gRPC server + direct_task_transport.cc).
+        self.direct_server: Optional[RpcServer] = None
+        direct_port = None
+        if not is_driver:
+            self.direct_server = RpcServer(
+                self, host=os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1"),
+                port=0,
+            )
+            direct_port = self.io.run(self.direct_server.start())
         reply = self.io.run(
             self.raylet.request(
                 "register_client",
                 {"client_id": self.client_id,
                  "kind": "driver" if is_driver else "worker",
-                 "job_id": self.job_id, "pid": os.getpid()},
+                 "job_id": self.job_id, "pid": os.getpid(),
+                 "direct_port": direct_port},
             )
         )
         self.node_id: str = reply["node_id"]
@@ -153,6 +166,18 @@ class CoreWorker:
         # tick-batched task submission buffer (see _submit_when_ready)
         self._submit_buf: List[TaskSpec] = []
         self._submit_flushing = False
+        # direct task push over worker leases (ray:
+        # direct_task_transport.cc): per-scheduling-class pending queues,
+        # one pump task per active class, cached conns to leased workers
+        self._direct_q: Dict[tuple, deque] = {}
+        self._direct_pumps: set = set()
+        self._direct_conns: Dict[tuple, Connection] = {}
+        self._direct_events: Dict[tuple, asyncio.Event] = {}
+        # direct actor calls: actor_id -> {"q", "running", "conn"}
+        self._actor_direct: Dict[bytes, dict] = {}
+        # worker-side task-event buffer for direct-push executions
+        self._tev_buf: List[dict] = []
+        self._tev_flushing = False
         threading.Thread(
             target=self._release_drain_loop,
             name=f"ref-release-{self.client_id[:6]}", daemon=True,
@@ -254,17 +279,28 @@ class CoreWorker:
         spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
         with self._lock:
             self._task_arg_pins[spec.task_id] = pins
+        # Plain DEFAULT-strategy tasks go over worker leases: the raylet
+        # grants workers once per burst and tasks push straight to them
+        # (2 hops/task instead of 4, no raylet CPU in steady state).
+        # Placement-sensitive strategies stay raylet-routed.
+        if (cfg.direct_task_leases and spec.actor_id is None
+                and spec.scheduling.kind == "DEFAULT"):
+            self._direct_enqueue(spec)
+            return
+        # Actor calls push straight to the actor worker's own endpoint
+        # (ray: CoreWorkerDirectActorTaskSubmitter); in-order frames plus
+        # the executor's per-caller seq gate preserve call order. Falls
+        # back to raylet routing when no direct endpoint is known.
+        if (cfg.direct_actor_calls and spec.actor_id is not None
+                and not spec.actor_creation):
+            self._actor_direct_enqueue(spec)
+            return
         # Tick-batched submission: a burst of .remote() calls lands on the
         # io loop as many _submit_when_ready tasks in the same tick; buffer
         # them and ship ONE submit_batch frame (same discipline as the
-        # GCS pubsub outbox). Actor tasks keep the direct path — their
-        # per-actor FIFO relies on frame-arrival order per submission.
-        if spec.actor_id is not None and not spec.actor_creation:
-            try:
-                await self.raylet.request("submit_task", {"spec": spec})
-            except Exception as e:
-                self._fail_returns(spec, f"task submission failed: {e}")
-            return
+        # GCS pubsub outbox). Actor tasks ride the same buffer: the buffer
+        # is FIFO and the raylet enqueues a batch's actor tasks
+        # synchronously in spec order, so per-actor call order survives.
         self._submit_buf.append(spec)
         if not self._submit_flushing:
             self._submit_flushing = True
@@ -281,6 +317,316 @@ class CoreWorker:
         except Exception as e:
             for spec in batch:
                 self._fail_returns(spec, f"task submission failed: {e}")
+
+    # -- direct task push over worker leases ---------------------------
+    def _direct_enqueue(self, spec: TaskSpec):
+        key = (tuple(sorted(spec.resources.items())), repr(spec.runtime_env))
+        self._direct_q.setdefault(key, deque()).append(spec)
+        ev = self._direct_events.get(key)
+        if ev is None:
+            ev = self._direct_events[key] = asyncio.Event()
+        ev.set()
+        if key not in self._direct_pumps:
+            self._direct_pumps.add(key)
+            asyncio.get_running_loop().create_task(self._direct_pump(key))
+
+    async def _direct_pump(self, key: tuple):
+        """One pump per scheduling class: lease workers from the raylet,
+        fan feeders over the leases, return the leases when the class
+        queue drains. Zero grants (no local capacity / feature off on the
+        raylet) falls back to raylet-routed submission, which spills
+        across nodes as usual."""
+        q = self._direct_q[key]
+        try:
+            while q:
+                spec0 = q[0]
+                depth = cfg.direct_lease_pipeline_depth
+                want = min(cfg.direct_lease_max,
+                           max(1, (len(q) + depth - 1) // depth))
+                try:
+                    reply = await self.raylet.request(
+                        "lease_workers",
+                        {"resources": dict(spec0.resources),
+                         "runtime_env": spec0.runtime_env,
+                         "job_id": self.job_id, "count": want},
+                    )
+                    leases = reply.get("leases") or []
+                except Exception:
+                    leases = []
+                if not leases:
+                    batch = list(q)
+                    q.clear()
+                    try:
+                        await self.raylet.request(
+                            "submit_batch", {"specs": batch}
+                        )
+                    except Exception as e:
+                        for s in batch:
+                            self._fail_returns(
+                                s, f"task submission failed: {e}"
+                            )
+                    continue
+                # local leases can't absorb an arbitrarily deep queue; ship
+                # the far tail through the raylet so it can spill to other
+                # nodes instead of starving behind this node's workers
+                cap = len(leases) * depth * 8
+                if len(q) > cap:
+                    tail = [q.pop() for _ in range(len(q) - cap)]
+                    tail.reverse()
+                    try:
+                        await self.raylet.request(
+                            "submit_batch", {"specs": tail}
+                        )
+                    except Exception as e:
+                        for s in tail:
+                            self._fail_returns(
+                                s, f"task submission failed: {e}"
+                            )
+                loop = asyncio.get_running_loop()
+                ev = self._direct_events[key]
+                feeders = [
+                    loop.create_task(self._direct_feed(lease, q, ev))
+                    for lease in leases for _ in range(depth)
+                ]
+                await asyncio.gather(*feeders)
+                for lease in leases:
+                    try:
+                        await self.raylet.notify(
+                            "return_lease", {"lease_id": lease["lease_id"]}
+                        )
+                    except Exception:
+                        pass
+        finally:
+            self._direct_pumps.discard(key)
+            if q:  # a burst landed during the finally window: restart
+                if key not in self._direct_pumps:
+                    self._direct_pumps.add(key)
+                    asyncio.get_running_loop().create_task(
+                        self._direct_pump(key)
+                    )
+            else:
+                self._direct_q.pop(key, None)
+
+    async def _direct_conn(self, lease: dict) -> Optional[Connection]:
+        ep = (lease["host"], lease["port"])
+        conn = self._direct_conns.get(ep)
+        if conn is not None and not conn.closed:
+            return conn
+        try:
+            conn = await connect(ep[0], ep[1], handler=self,
+                                 name=f"direct:{ep[1]}", retries=2)
+        except Exception:
+            return None
+        self._direct_conns[ep] = conn
+        return conn
+
+    async def _direct_feed(self, lease: dict, q: deque, ev: asyncio.Event):
+        conn = await self._direct_conn(lease)
+        while True:
+            if not q:
+                # linger: a sequential submit-get loop reuses the standing
+                # lease (2 hops/call) instead of re-leasing per call
+                ev.clear()
+                if q:  # a spec landed between the check and the clear
+                    ev.set()
+                    continue
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), cfg.direct_lease_linger_s
+                    )
+                except asyncio.TimeoutError:
+                    return
+                continue
+            spec = q.popleft()
+            if conn is None or conn.closed:
+                # endpoint gone BEFORE anything was sent: the task never
+                # started, so reroute via the raylet without consuming a
+                # retry attempt (at-most-once was never at risk)
+                try:
+                    await self.raylet.request("submit_task", {"spec": spec})
+                except Exception as e:
+                    self._fail_returns(spec, f"task submission failed: {e}")
+                return
+            try:
+                result = await conn.request("execute_task", {"spec": spec})
+            except Exception:
+                await self._direct_worker_lost(spec, lease)
+                return
+            await self._direct_result(spec, result)
+
+    # -- direct actor calls --------------------------------------------
+    def _actor_direct_enqueue(self, spec: TaskSpec):
+        st = self._actor_direct.setdefault(
+            spec.actor_id,
+            {"q": deque(), "running": False, "conn": None,
+             "fallback": False, "inflight": 0, "relost": [],
+             "settled": asyncio.Event()},
+        )
+        st["q"].append(spec)
+        if not st["running"]:
+            st["running"] = True
+            asyncio.get_running_loop().create_task(
+                self._actor_sender(spec.actor_id, st)
+            )
+
+    async def _actor_sender(self, actor_id: bytes, st: dict):
+        """Single sender per actor: pipelined in-order request_nowait
+        pushes over one connection (wire order = call order; replies are
+        awaited concurrently).
+
+        Ordering across failures: once ANY call for this actor has been
+        routed via the raylet (direct endpoint unavailable, or a direct
+        conn broke mid-burst), the actor goes into STICKY raylet fallback.
+        Mixing routes would let a later seq overtake an earlier one in the
+        restart window, and the fresh executor's seq gate would anchor on
+        the wrong call. Recovery waits for every in-flight direct reply to
+        settle, then resubmits the failed calls lowest-seq-first ahead of
+        anything still queued."""
+        loop = asyncio.get_running_loop()
+        try:
+            while st["q"] or st["relost"]:
+                if st["fallback"]:
+                    # collect every outcome before rerouting so the raylet
+                    # sees the calls in seq order
+                    while st["inflight"]:
+                        st["settled"].clear()
+                        await st["settled"].wait()
+                    relost, st["relost"] = st["relost"], []
+                    relost.sort(key=lambda s: s.seq_no)
+                    batch = relost + list(st["q"])
+                    st["q"].clear()
+                    if not batch:
+                        continue
+                    try:
+                        await self.raylet.request(
+                            "submit_batch", {"specs": batch}
+                        )
+                    except Exception as e:
+                        for s in batch:
+                            self._fail_returns(
+                                s, f"task submission failed: {e}"
+                            )
+                    continue
+                conn = st["conn"]
+                if conn is None or conn.closed:
+                    # never dial a new incarnation while old in-flight
+                    # calls are unsettled: the new conn could deliver a
+                    # later seq before the earlier seq's failure rerouted
+                    while st["inflight"]:
+                        st["settled"].clear()
+                        await st["settled"].wait()
+                    if st["fallback"]:
+                        continue
+                    conn = await self._actor_direct_connect(actor_id)
+                    st["conn"] = conn
+                    if conn is None:
+                        st["fallback"] = True
+                        continue
+                spec = st["q"].popleft()
+                try:
+                    fut = conn.request_nowait("execute_task", {"spec": spec})
+                except Exception:
+                    st["conn"] = None
+                    st["fallback"] = True
+                    st["relost"].append(spec)
+                    continue
+                st["inflight"] += 1
+                loop.create_task(
+                    self._actor_direct_reply(actor_id, st, spec, fut)
+                )
+        finally:
+            st["running"] = False
+            if (st["q"] or st["relost"]) and not st["running"]:
+                st["running"] = True
+                loop.create_task(self._actor_sender(actor_id, st))
+
+    async def _actor_direct_connect(self, actor_id: bytes):
+        try:
+            table = await self.gcs.request(
+                "wait_actor_alive",
+                {"actor_id": actor_id,
+                 "timeout": cfg.actor_route_wait_alive_timeout_s},
+            )
+        except Exception:
+            return None
+        if (not table or table.get("state") != "ALIVE"
+                or not table.get("direct_addr")):
+            return None
+        host, port = table["direct_addr"]
+        try:
+            return await connect(host, port, handler=self,
+                                 name=f"actor-direct:{port}", retries=2)
+        except Exception:
+            return None
+
+    async def _actor_direct_reply(self, actor_id: bytes, st: dict,
+                                  spec: TaskSpec, fut):
+        try:
+            result = await fut
+        except Exception:
+            # worker died / restarting: flip to sticky raylet fallback and
+            # park the call for the sender's seq-ordered recovery drain
+            st["fallback"] = True
+            if st.get("conn") is not None and st["conn"].closed:
+                st["conn"] = None
+            st["relost"].append(spec)
+            st["inflight"] -= 1
+            st["settled"].set()
+            if not st["running"]:
+                st["running"] = True
+                asyncio.get_running_loop().create_task(
+                    self._actor_sender(actor_id, st)
+                )
+            return
+        st["inflight"] -= 1
+        st["settled"].set()
+        await self._direct_result(spec, result)
+
+    async def _direct_worker_lost(self, spec: TaskSpec,
+                                  lease: Optional[dict] = None):
+        """Leased worker died/unreachable mid-push: resolve WHY from the
+        raylet (e.g. an OOM kill must surface as such, not as a generic
+        connection loss), then feed the standard failure path (it retries
+        via the raylet when retriable)."""
+        reason = "leased worker lost"
+        if lease and lease.get("worker_id"):
+            for _ in range(3):
+                try:
+                    fate = await self.raylet.request(
+                        "worker_fate", {"client_id": lease["worker_id"]}
+                    )
+                except Exception:
+                    break
+                if fate.get("reason"):
+                    reason = fate["reason"]
+                    break
+                if not fate.get("alive"):
+                    break
+                # raylet hasn't processed the worker's death yet
+                await asyncio.sleep(0.1)
+        await self.rpc_task_result(self.raylet, {
+            "task_id": spec.task_id, "results": None,
+            "error": reason, "system_error": True,
+            "retriable": True, "attempt": spec.attempt,
+        })
+
+    async def _direct_result(self, spec: TaskSpec, result: dict):
+        """Adapt the executor's result dict into the task_result payload
+        the raylet would have delivered (raylet._deliver_result shape);
+        stored-object locations were self-reported by the worker."""
+        await self.rpc_task_result(self.raylet, {
+            "task_id": spec.task_id,
+            "results": result.get("results"),
+            "error": result.get("error"),
+            "error_value": result.get("error_value"),
+            "app_error": result.get("app_error", False),
+            "retriable": result.get("retriable", False),
+            "attempt": spec.attempt,
+            "exec_addr": result.get("exec_addr"),
+            "borrows_kept": result.get("borrows_kept"),
+            "returns_nested": result.get("returns_nested"),
+            "dynamic_return_oids": result.get("dynamic_return_oids"),
+        })
 
     def _release_task_pins(self, task_id: bytes):
         with self._lock:
@@ -558,6 +904,12 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # owner notifications (results arrive here)
     # ------------------------------------------------------------------
+    async def rpc_task_result_batch(self, conn: Connection, payloads):
+        """Tick-batched completions from the raylet (one frame per burst;
+        see raylet._flush_owner_outbox)."""
+        for p in payloads:
+            await self.rpc_task_result(conn, p)
+
     async def rpc_task_result(self, conn: Connection, p):
         task_id: bytes = p["task_id"]
         with self._lock:
@@ -818,7 +1170,61 @@ class CoreWorker:
 
     async def rpc_execute_task(self, conn: Connection, p):
         ex = await self._await_executor()
-        return await ex.execute_task(p["spec"])
+        direct = conn is not self.raylet
+        if direct:
+            # the raylet never sees direct-push tasks, so this worker owns
+            # their observability record (state API / timeline parity with
+            # raylet-routed tasks)
+            self._emit_direct_task_event(p["spec"], "RUNNING")
+        result = await ex.execute_task(p["spec"])
+        if direct:
+            if result.get("error") is not None:
+                self._emit_direct_task_event(
+                    p["spec"], "FAILED",
+                    error=str(result.get("error"))[:200],
+                )
+            else:
+                self._emit_direct_task_event(
+                    p["spec"], "FINISHED", duration=result.get("duration"),
+                )
+            if result.get("stored_objects"):
+                # stored outputs must be self-reported for location tracking
+                try:
+                    await self.raylet.notify(
+                        "register_stored",
+                        {"object_ids": list(result["stored_objects"])},
+                    )
+                except Exception:
+                    pass
+        return result
+
+    def _emit_direct_task_event(self, spec: TaskSpec, state: str, **extra):
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "job_id": spec.job_id.hex() if spec.job_id else None,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "attempt": spec.attempt,
+            "state": state,
+            "ts": time.time(),
+            "node_id": self.node_id,
+            "pid": os.getpid(),
+        }
+        ev.update(extra)
+        self._tev_buf.append(ev)
+        if not self._tev_flushing:
+            self._tev_flushing = True
+            asyncio.get_running_loop().create_task(self._flush_task_events())
+
+    async def _flush_task_events(self):
+        buf, self._tev_buf = self._tev_buf, []
+        self._tev_flushing = False
+        if not buf:
+            return
+        try:
+            await self.raylet.notify("task_events", {"events": buf})
+        except Exception:
+            pass
 
     async def rpc_become_actor(self, conn: Connection, p):
         ex = await self._await_executor()
@@ -1539,6 +1945,11 @@ class CoreWorker:
     def disconnect(self):
         self.connected = False
         try:
+            for conn in list(self._direct_conns.values()):
+                self.io.run(conn.close(), timeout=2)
+            for st in list(self._actor_direct.values()):
+                if st.get("conn") is not None:
+                    self.io.run(st["conn"].close(), timeout=2)
             self.io.run(self.raylet.close(), timeout=2)
             self.io.run(self.gcs.close(), timeout=2)
         except Exception:

@@ -66,31 +66,36 @@ long rtpu_write_object(const char* store_dir, const char* oid_hex,
 
   const std::string tmp =
       final_path + ".building." + std::to_string(::getpid());
-  int fd = ::open(tmp.c_str(), O_CREAT | O_TRUNC | O_RDWR, 0644);
+  int fd = ::open(tmp.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
   if (fd < 0) return -1;
-  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
-    ::close(fd);
-    ::unlink(tmp.c_str());
-    return -1;
+  // write() instead of ftruncate+mmap+memcpy: filling fresh tmpfs pages
+  // through a mapping pays a page fault + kernel zeroing per page
+  // (~1.3 GB/s measured on this host); full-page write() skips the
+  // zeroing and the faults (~3 GB/s).
+  auto write_all = [fd](const uint8_t* p, uint64_t n) -> bool {
+    while (n > 0) {
+      ssize_t w = ::write(fd, p, n);
+      if (w < 0 && errno == EINTR) continue;  // CPython signals lack
+      // SA_RESTART in extension code; a SIGCHLD mid-copy is not an error
+      if (w <= 0) return false;
+      p += w;
+      n -= static_cast<uint64_t>(w);
+    }
+    return true;
+  };
+  uint8_t header[kHeader];
+  std::memcpy(header, kMagic, 8);
+  std::memcpy(header + 8, &meta_len, 8);
+  std::memcpy(header + 16, &data_len, 8);
+  bool ok = write_all(header, kHeader) && write_all(metadata, meta_len);
+  for (uint64_t i = 0; ok && i < nbufs; ++i) {
+    ok = write_all(bufs[i], buf_lens[i]);
   }
-  void* map = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-  if (map == MAP_FAILED) {
-    ::close(fd);
-    ::unlink(tmp.c_str());
-    return -1;
-  }
-  uint8_t* p = static_cast<uint8_t*>(map);
-  std::memcpy(p, kMagic, 8);
-  std::memcpy(p + 8, &meta_len, 8);
-  std::memcpy(p + 16, &data_len, 8);
-  std::memcpy(p + kHeader, metadata, meta_len);
-  uint8_t* cursor = p + kHeader + meta_len;
-  for (uint64_t i = 0; i < nbufs; ++i) {
-    std::memcpy(cursor, bufs[i], buf_lens[i]);
-    cursor += buf_lens[i];
-  }
-  ::munmap(map, total);
   ::close(fd);
+  if (!ok) {
+    ::unlink(tmp.c_str());
+    return -1;
+  }
   if (::rename(tmp.c_str(), final_path.c_str()) != 0) {
     ::unlink(tmp.c_str());
     return -1;
