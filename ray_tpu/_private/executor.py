@@ -299,7 +299,10 @@ class TaskExecutor:
         oid = ObjectID(oid_bytes)
         buf = object_store.read_object(self.cw.store_dir, oid)
         if buf is None:
-            ok = await self.cw.raylet.request("pull_object", {"object_id": oid_bytes})
+            ok = await self.cw.raylet.request(
+                "pull_object",
+                {"object_id": oid_bytes,
+                 "owner": slot[2] if len(slot) > 2 else None})
             if not ok.get("ok"):
                 raise RuntimeError(f"task argument {oid_bytes.hex()[:16]} unavailable")
             buf = object_store.read_object(self.cw.store_dir, oid)

@@ -244,6 +244,7 @@ class Raylet:
         # reported as autoscaler demand, retried as capacity appears.
         self.infeasible: Dict[bytes, _QueuedTask] = {}
         self.dep_waiters: Dict[bytes, List[bytes]] = {}  # object -> task_ids
+        self.dep_owners: Dict[bytes, tuple] = {}  # object -> owner addr
         self.pg_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         # per-actor FIFO routing (ordered delivery; see rpc_submit_task)
         self._actor_route_queues: Dict[bytes, deque] = {}
@@ -1115,10 +1116,14 @@ class Raylet:
                 oid = a[1]
                 if not self.store.contains(ObjectID(oid)):
                     missing.append(oid)
+                    # remember the owner for the owner-first pull
+                    if len(a) > 2 and a[2] is not None:
+                        self.dep_owners.setdefault(oid, tuple(a[2]))
         return missing
 
     async def _pull_for_dep(self, oid: bytes):
-        ok = await self._ensure_local(oid, priority=PULL_PRIO_TASK_ARGS)
+        ok = await self._ensure_local(oid, priority=PULL_PRIO_TASK_ARGS,
+                                      owner=self.dep_owners.pop(oid, None))
         waiters = self.dep_waiters.pop(oid, [])
         for tid in waiters:
             qt = self.waiting.get(tid)
@@ -1573,15 +1578,18 @@ class Raylet:
         return {}
 
     async def rpc_pull_object(self, conn: Connection, p):
+        owner = p.get("owner")
         ok = await self._ensure_local(
             p["object_id"], timeout=p.get("timeout"),
             priority=p.get("priority", PULL_PRIO_GET),
+            owner=tuple(owner) if owner else None,
         )
         return {"ok": ok}
 
     async def _ensure_local(self, oid_bytes: bytes,
                             timeout: Optional[float] = None,
-                            priority: int = PULL_PRIO_GET) -> bool:
+                            priority: int = PULL_PRIO_GET,
+                            owner: Optional[tuple] = None) -> bool:
         oid = ObjectID(oid_bytes)
         if self.store.contains(oid):
             # May be spilled: bring it back into shm so workers can mmap it.
@@ -1591,13 +1599,24 @@ class Raylet:
             return True
         fut = self._pulls_inflight.get(oid_bytes)
         if fut is not None:
-            return await fut
+            ok = await fut
+            if ok or owner is None:
+                return ok
+            # the coalesced pull may have lacked our owner hint (e.g. an
+            # ownerless pull racing a dep pull during a GCS outage): try
+            # once more owner-aware now that the failed pull is cleared
+            if self._pulls_inflight.get(oid_bytes) is None:
+                return await self._ensure_local(
+                    oid_bytes, timeout=timeout, priority=priority,
+                    owner=owner,
+                )
+            return ok
         fut = asyncio.get_running_loop().create_future()
         self._pulls_inflight[oid_bytes] = fut
         try:
             await self._pull_gate.acquire(priority)
             try:
-                ok = await self._do_pull(oid, timeout=timeout)
+                ok = await self._do_pull(oid, timeout=timeout, owner=owner)
             finally:
                 self._pull_gate.release_slot()
             # an incoming push may have satisfied (and resolved) us already
@@ -1612,26 +1631,52 @@ class Raylet:
         finally:
             self._pulls_inflight.pop(oid_bytes, None)
 
-    async def _do_pull(self, oid: ObjectID, timeout: Optional[float] = None) -> bool:
+    async def _do_pull(self, oid: ObjectID, timeout: Optional[float] = None,
+                       owner: Optional[tuple] = None) -> bool:
+        """Resolve locations OWNER-FIRST (ray:
+        ownership_based_object_directory.h): the owning worker is the
+        authority on where its object has copies; the GCS directory is
+        only the bootstrap/cache fallback. Pulls therefore keep working
+        through a GCS outage or restart whenever the caller knows the
+        owner (task args and driver gets do)."""
         deadline = time.monotonic() + (timeout or cfg.object_pull_timeout_s)
         while time.monotonic() < deadline:
-            try:
-                locs = await self.gcs.request(
-                    "get_object_locations",
-                    {"object_id": oid.binary(), "wait": True,
-                     "timeout": min(5.0, deadline - time.monotonic())},
+            owner_locs: list = []
+            if owner is not None:
+                owner_locs = await self._query_owner_locations(
+                    owner, oid, deadline
                 )
+            # Merge rather than short-circuit: a stale owner entry (no
+            # removal protocol on eviction) must not shadow a live copy
+            # the GCS knows about. A dead GCS just contributes nothing.
+            locs = list(owner_locs)
+            try:
+                gcs_locs = await self.gcs.request(
+                    "get_object_locations",
+                    {"object_id": oid.binary(), "wait": not owner_locs,
+                     "timeout": max(0.1, min(5.0,
+                                             deadline - time.monotonic()))},
+                )
+                locs.extend(l for l in gcs_locs if l not in locs)
             except Exception:
-                locs = []
+                pass
             locs = [l for l in locs if l != self.node_id]
             if not locs and self.store.contains(oid):
                 return True
             for node_id in locs:
                 peer = await self._peer(node_id)
-                if peer is None:
-                    continue
-                if await self._fetch_from(peer, oid):
+                if peer is not None and await self._fetch_from(peer, oid):
                     self.counters["objects_pulled"] += 1
+                    if node_id in owner_locs:
+                        self.counters["owner_location_hits"] = (
+                            self.counters.get("owner_location_hits", 0) + 1
+                        )
+                    if owner is not None:
+                        await self._send_to_owner(
+                            owner[0], owner[1], "owner_add_location",
+                            {"object_id": oid.binary(),
+                             "node_id": self.node_id},
+                        )
                     try:
                         await self.gcs.request(
                             "add_object_location",
@@ -1640,10 +1685,60 @@ class Raylet:
                     except Exception:
                         pass
                     return True
+                if owner is not None and node_id in owner_locs:
+                    # unreachable/empty copy: retract the stale entry so
+                    # the owner directory converges
+                    await self._send_to_owner(
+                        owner[0], owner[1], "owner_remove_location",
+                        {"object_id": oid.binary(), "node_id": node_id},
+                    )
             if self.store.contains(oid):
                 return True
             await asyncio.sleep(cfg.pull_location_poll_interval_s)
         return False
+
+    async def _query_owner_locations(self, owner: tuple, oid: ObjectID,
+                                     deadline: float) -> list:
+        # cap by the pull deadline: this runs while holding a pull-gate
+        # slot, so a half-open owner connection must not starve the gate
+        # for a full RPC timeout per attempt
+        budget = max(0.1, min(cfg.gcs_rpc_timeout_s,
+                              deadline - time.monotonic()))
+        node_id, client_id = tuple(owner)
+        try:
+            if node_id == self.node_id:
+                conn = self.clients.get(client_id)
+                if conn is None or conn.closed:
+                    return []
+                reply = await conn.request(
+                    "object_locations", {"object_id": oid.binary()},
+                    timeout=budget,
+                )
+            else:
+                peer = await self._peer(node_id)
+                if peer is None:
+                    return []
+                reply = await peer.request(
+                    "owner_locations",
+                    {"client_id": client_id, "object_id": oid.binary()},
+                    timeout=budget,
+                )
+            return list(reply.get("locations") or [])
+        except Exception:
+            return []
+
+    async def rpc_owner_locations(self, conn: Connection, p):
+        """Peer raylet resolving an owner that is OUR local client."""
+        c = self.clients.get(p["client_id"])
+        if c is None or c.closed:
+            return {"locations": []}
+        try:
+            return await c.request(
+                "object_locations", {"object_id": p["object_id"]},
+                timeout=cfg.gcs_rpc_timeout_s,
+            )
+        except Exception:
+            return {"locations": []}
 
     async def _fetch_from(self, peer: Connection, oid: ObjectID) -> bool:
         chunk = cfg.object_transfer_chunk_bytes

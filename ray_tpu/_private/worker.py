@@ -101,8 +101,15 @@ class CoreWorker:
         self.raylet: Connection = self.io.run(
             connect(raylet_host, raylet_port, handler=self, name="raylet-conn")
         )
+        # workers spawned during a GCS outage must come up once it returns:
+        # give non-drivers the same patience as the raylet reconnect loop
+        # instead of the default ~3s of connect retries
+        gcs_retries = None if is_driver else max(1, int(
+            cfg.gcs_client_reconnect_timeout_s / cfg.rpc_connect_retry_delay_s
+        ))
         self.gcs: Connection = self.io.run(
-            connect(gcs_host, gcs_port, handler=self, name="gcs-conn")
+            connect(gcs_host, gcs_port, handler=self, name="gcs-conn",
+                    retries=gcs_retries)
         )
         self.gcs_addr = (gcs_host, gcs_port)
         if is_driver and job_id is None:
@@ -156,6 +163,13 @@ class CoreWorker:
         self._put_index = 0
         self._local_refs: Dict[bytes, int] = {}
         self._owned: set = set()
+        # ownership-based object directory (ray:
+        # src/ray/object_manager/ownership_based_object_directory.h +
+        # reference_count.h:61): the OWNER is the authority on where its
+        # objects have copies; raylets query here first and treat the GCS
+        # directory as bootstrap/cache, so a GCS restart mid-transfer
+        # doesn't stall pulls on a full location replay.
+        self._owned_locations: Dict[bytes, set] = {}
         # Lock-free queue of ref releases deferred from ObjectRef.__del__
         # (GC can fire inside locked sections; see defer_ref_release).
         self._deferred_releases: deque = deque()
@@ -904,6 +918,40 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # owner notifications (results arrive here)
     # ------------------------------------------------------------------
+    # -- ownership-based object directory ------------------------------
+    async def rpc_object_locations(self, conn: Connection, p):
+        """Location lookup served by the OWNER (ray:
+        ownership_based_object_directory.h) — raylets resolve here first,
+        GCS directory second."""
+        oid = p["object_id"]
+        with self._lock:
+            locs = set(self._owned_locations.get(oid, ()))
+        if object_store.object_exists(self.store_dir, ObjectID(oid)):
+            locs.add(self.node_id)
+        return {"locations": list(locs)}
+
+    def rpc_owner_add_location(self, conn: Connection, p):
+        """A raylet created/received a copy of an object we own."""
+        with self._lock:
+            if p["object_id"] in self._owned:
+                self._owned_locations.setdefault(
+                    p["object_id"], set()
+                ).add(p["node_id"])
+
+    def rpc_owner_remove_location(self, conn: Connection, p):
+        """A raylet found our recorded copy unreachable/gone: retract it
+        so the directory converges (there is no eviction protocol)."""
+        with self._lock:
+            locs = self._owned_locations.get(p["object_id"])
+            if locs is not None:
+                locs.discard(p["node_id"])
+
+    def _record_owned_location(self, oid: bytes, node_id: Optional[str]):
+        if not node_id:
+            return
+        with self._lock:
+            self._owned_locations.setdefault(oid, set()).add(node_id)
+
     async def rpc_task_result_batch(self, conn: Connection, payloads):
         """Tick-batched completions from the raylet (one frame per burst;
         see raylet._flush_owner_outbox)."""
@@ -934,6 +982,7 @@ class CoreWorker:
         # at-least-once resubmission path can deliver task_result twice, and
         # re-adopting would re-pin items under a ref-list that may already
         # have been freed, leaking escape pins.
+        exec_node = (p.get("exec_addr") or (None,))[0]
         if dyn_oids and spec is not None:
             list_oid = ObjectID.from_index(tid, 1).binary()
             tokens = []
@@ -942,6 +991,7 @@ class CoreWorker:
                     self._owned.add(oid)
                     if spec is not None:
                         self._lineage_insert_locked(oid, spec)
+                self._record_owned_location(oid, exec_node)
                 tokens.append(self.pin_object(oid, self.addr))
                 # a reconstruction (or wait) may be parked on this item
                 self._resolve_plasma(oid)
@@ -952,6 +1002,9 @@ class CoreWorker:
             if res[0] == "v":
                 self._resolve_inline(oid.binary(), res[1], res[2])
             else:
+                # the stored return lives on the executing node: record it
+                # in the owner directory before anyone asks
+                self._record_owned_location(oid.binary(), exec_node)
                 self._resolve_plasma(oid.binary())
         if spec is not None and any(r[0] == "r" for r in results):
             self._record_lineage(spec)
@@ -1307,6 +1360,7 @@ class CoreWorker:
                 self.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
             )
             self.io.run(self.raylet.request("register_put", {"object_id": oid.binary()}))
+            self._record_owned_location(oid.binary(), self.node_id)
             with self._lock:
                 self._owned.add(oid.binary())
                 if tokens:
@@ -1348,7 +1402,8 @@ class CoreWorker:
         try:
             ok = await self.raylet.request(
                 "pull_object",
-                {"object_id": oid, "timeout": cfg.object_pull_timeout_s},
+                {"object_id": oid, "timeout": cfg.object_pull_timeout_s,
+                 "owner": self.addr},
             )
             if ok.get("ok") and object_store.object_exists(
                 self.store_dir, ref.id()
@@ -1399,7 +1454,9 @@ class CoreWorker:
                     self._resolve_inline(oid, meta, data)
                     return
                 if r.get("plasma"):
-                    ok = (await self.raylet.request("pull_object", {"object_id": oid}))["ok"]
+                    ok = (await self.raylet.request(
+                        "pull_object",
+                        {"object_id": oid, "owner": tuple(owner)}))["ok"]
                     if ok and not fut.done():
                         fut.set_result(("plasma", None, None))
                         return
@@ -1408,7 +1465,10 @@ class CoreWorker:
                     deadline = time.monotonic() + cfg.object_pull_timeout_s
             else:
                 try:
-                    ok = (await self.raylet.request("pull_object", {"object_id": oid}))["ok"]
+                    ok = (await self.raylet.request(
+                        "pull_object",
+                        {"object_id": oid,
+                         "owner": tuple(owner) if owner else None}))["ok"]
                     if ok and not fut.done():
                         fut.set_result(("plasma", None, None))
                         return
@@ -1443,7 +1503,9 @@ class CoreWorker:
         oid = ref.id()
         buf = object_store.read_object(self.store_dir, oid)
         if buf is None:
-            ok = self.io.run(self.raylet.request("pull_object", {"object_id": ref.binary()}))
+            ok = self.io.run(self.raylet.request(
+                "pull_object",
+                {"object_id": ref.binary(), "owner": ref.owner}))
             if ok.get("ok"):
                 buf = object_store.read_object(self.store_dir, oid)
         if buf is None:
@@ -1915,6 +1977,7 @@ class CoreWorker:
             if tid in self._specs_inflight:
                 return  # producing task still running
             self._owned.discard(oid)
+            self._owned_locations.pop(oid, None)
             self._memory_store.pop(oid, None)
             self._futures.pop(oid, None)
             # Lineage is deliberately NOT popped here: a downstream object's
