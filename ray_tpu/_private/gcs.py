@@ -315,6 +315,14 @@ class GcsServer:
             {"node_id": node.node_id},
         )
         await self._broadcast_view()
+        # New capacity: placement groups that gave up as INFEASIBLE get
+        # another scheduling run (the autoscaler may have just launched
+        # the slice their bundles were waiting for).
+        for pg in list(self.pgs.values()):
+            if pg.state == "INFEASIBLE":
+                pg.state = "PENDING"
+                self._persist_pg(pg)
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
         return {"node_id": node.node_id, "nodes": self._view()}
 
     async def _reconcile_node_state(self, node_id: str, state: dict):
@@ -383,6 +391,12 @@ class GcsServer:
         for rec in self.actors.values():
             if rec.state in (PENDING_CREATION, RESTARTING) and rec.spec.resources:
                 demand.append(dict(rec.spec.resources))
+        # Unplaced placement groups report every bundle (ray: the
+        # autoscaler sees PG demand via placement_group_load) — this is
+        # what makes pending TPU PGs launch whole slices.
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "INFEASIBLE"):
+                demand.extend(dict(b) for b in pg.bundles)
         return {"nodes": nodes, "pending_demand": demand}
 
     async def rpc_get_nodes(self, conn: Connection, _):
@@ -882,7 +896,10 @@ class GcsServer:
             pg = self.pgs.get(p["pg_id"])
             if pg is None:
                 return None
-            if pg.state in ("CREATED", "INFEASIBLE", "REMOVED"):
+            # INFEASIBLE is NOT terminal: the autoscaler may be
+            # provisioning the slice right now, and node registration
+            # flips the PG back to PENDING — so waiters keep waiting.
+            if pg.state in ("CREATED", "REMOVED"):
                 return pg.to_table()
             await asyncio.sleep(0.02)
         pg = self.pgs.get(p["pg_id"])

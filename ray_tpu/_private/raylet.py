@@ -2184,6 +2184,13 @@ class Raylet:
             "num_workers": len(self.all_workers),
             "num_idle_workers": sum(len(q) for q in self.idle_workers.values()),
             "queued": len(self.ready) + len(self.waiting),
+            "infeasible": len(self.infeasible),
+            "infeasible_shapes": [dict(qt.resources)
+                                  for qt in self.infeasible.values()][:5],
+            "cluster_view_totals": {
+                nid[:8]: dict(n.resources_total)
+                for nid, n in self.cluster_view.items()
+            },
             "running": len(self.running),
             "store_used_bytes": self.store.used_bytes(),
             "counters": dict(self.counters),

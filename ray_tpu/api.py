@@ -297,6 +297,16 @@ def _build_resources(opts: dict, default_cpu: float) -> Dict[str, float]:
 def _build_scheduling(opts: dict) -> SchedulingStrategy:
     strategy = opts.get("scheduling_strategy")
     if strategy is None or strategy == "DEFAULT":
+        # legacy PG options (ray parity: .options(placement_group=pg,
+        # placement_group_bundle_index=i) without an explicit strategy)
+        pg = opts.get("placement_group")
+        if pg is not None:
+            idx = opts.get("placement_group_bundle_index")
+            return SchedulingStrategy(
+                kind="PLACEMENT_GROUP",
+                pg_id=pg.id_hex,
+                pg_bundle_index=None if idx in (None, -1) else idx,
+            )
         return SchedulingStrategy()
     if strategy == "SPREAD":
         return SchedulingStrategy(kind="SPREAD")

@@ -12,9 +12,13 @@ fake_multi_node/node_provider.py:237).
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import (
+    FakeQueuedResourceAPI,
     FakeTpuPodProvider,
+    GkeQueuedResourceAPI,
     MockProvider,
     NodeProvider,
+    QueuedResourceAPI,
+    TpuPodProvider,
 )
 
 __all__ = [
@@ -22,4 +26,8 @@ __all__ = [
     "NodeProvider",
     "MockProvider",
     "FakeTpuPodProvider",
+    "QueuedResourceAPI",
+    "FakeQueuedResourceAPI",
+    "GkeQueuedResourceAPI",
+    "TpuPodProvider",
 ]

@@ -14,6 +14,13 @@ Config shape (the available_node_types subset of ray's cluster YAML):
                      "min_workers": 0, "max_workers": 4},
       ...
     }
+
+TPU-pod slice types add ``"hosts": N``: one launched unit is a WHOLE
+slice of N hosts, each advertising ``resources`` (scale-up granularity
+is the slice topology — you cannot ask a Queued-Resources API for half
+a v5e-16). Bundles bin-pack per HOST: a {"TPU": 4} bundle fits one
+v5e-16 host, but {"TPU": 16} fits no single host and is infeasible on
+that type even though the slice aggregate is 16.
 """
 
 from __future__ import annotations
@@ -108,11 +115,19 @@ class StandardAutoscaler:
         # within the boot grace window, so unmatched nodes don't become
         # permanent phantom capacity.
         for nid, t in running.items():
-            if t not in self.node_types or self._registered(nid, load):
+            if t not in self.node_types:
                 continue
+            spec = self.node_types[t]
+            expected = int(spec.get("hosts", 1))
+            matched = len(self._find_load_nodes(nid, load))
             age = now - self._launch_times.get(nid, now)
-            if age <= self.node_boot_grace_s:
-                free.append(dict(self.node_types[t].get("resources", {})))
+            if matched < expected and age <= self.node_boot_grace_s:
+                # multi-host slices boot staggered: count bins only for
+                # the hosts still missing, or one early-registering host
+                # would erase its siblings' capacity and trigger a
+                # duplicate (billed!) slice launch
+                for _ in range(expected - matched):
+                    free.append(dict(spec.get("resources", {})))
 
         # First-fit each bundle onto existing/just-launched capacity;
         # launch a new node only when nothing absorbs it. Demand arrives
@@ -147,10 +162,13 @@ class StandardAutoscaler:
                     self._launch_times[new_id] = now
                 counts[chosen] = counts.get(chosen, 0) + 1
                 launched[chosen] = launched.get(chosen, 0) + 1
-                # The new node absorbs this and possibly later bundles.
-                cap = dict(self.node_types[chosen].get("resources", {}))
-                _consume(bundle, cap)
-                free.append(cap)
+                # The new unit absorbs this and possibly later bundles.
+                # A slice type contributes one capacity bin PER HOST.
+                spec = self.node_types[chosen]
+                hosts = [dict(spec.get("resources", {}))
+                         for _ in range(int(spec.get("hosts", 1)))]
+                _consume(bundle, hosts[0])
+                free.extend(hosts)
 
         # Scale down: provider nodes whose raylet has been idle past the
         # timeout, never below min_workers. Requires the provider to
@@ -161,8 +179,14 @@ class StandardAutoscaler:
             spec = self.node_types.get(node_type, {})
             if counts.get(node_type, 0) <= spec.get("min_workers", 0):
                 continue
-            node = self._find_load_node(nid, load)
-            if node is not None and node.get("idle_s", 0.0) > self.idle_timeout_s:
+            nodes = self._find_load_nodes(nid, load)
+            # a multi-host slice terminates whole: only when every
+            # EXPECTED host has registered AND been idle past the timeout
+            # (a partially-booted slice's early host idling while its
+            # gang peers provision must not kill the slice mid-boot)
+            if nodes and len(nodes) >= int(spec.get("hosts", 1)) and all(
+                n.get("idle_s", 0.0) > self.idle_timeout_s for n in nodes
+            ):
                 self.provider.terminate_node(nid)
                 self._launch_times.pop(nid, None)
                 counts[node_type] -= 1
@@ -170,23 +194,22 @@ class StandardAutoscaler:
         return {"launched": launched, "terminated": terminated}
 
     def _registered(self, provider_id: str, load: dict) -> bool:
-        node = self._find_load_node(provider_id, load)
-        return node is not None
+        return bool(self._find_load_nodes(provider_id, load))
 
-    def _find_load_node(self, provider_id: str, load: dict) -> Optional[dict]:
-        """Match a provider node to its registered raylet. Providers that
-        implement ``raylet_node_id`` (FakeTpuPodProvider) match exactly;
-        others return None — such nodes count as booting only within the
-        grace window and are never auto-terminated."""
-        raylet_id = getattr(self.provider, "raylet_node_id", lambda _: None)(
-            provider_id
-        )
-        if raylet_id is None:
-            return None
-        for n in load.get("nodes", []):
-            if n["node_id"] == raylet_id:
-                return n
-        return None
+    def _find_load_nodes(self, provider_id: str, load: dict) -> List[dict]:
+        """Match a provider unit to its registered raylet(s). Providers
+        implementing ``raylet_node_ids`` (slices) or ``raylet_node_id``
+        match exactly; others return [] — such nodes count as booting
+        only within the grace window and are never auto-terminated."""
+        many = getattr(self.provider, "raylet_node_ids", None)
+        if many is not None:
+            ids = [i for i in (many(provider_id) or []) if i]
+        else:
+            one = getattr(self.provider, "raylet_node_id",
+                          lambda _: None)(provider_id)
+            ids = [one] if one else []
+        by_id = {n["node_id"]: n for n in load.get("nodes", [])}
+        return [by_id[i] for i in ids if i in by_id]
 
     def run_loop(self, interval_s: float = 5.0, stop_event=None):
         """Monitor loop (ray: monitor.py Monitor)."""

@@ -108,3 +108,137 @@ def test_autoscaler_e2e_fake_tpu_pod(ray_start_cluster):
         assert provider.non_terminated_nodes() == {}
     finally:
         provider.shutdown()
+
+
+SLICE_TYPES = {
+    # one unit = a v5e-16 slice: 4 hosts x {TPU: 4, CPU: 8}
+    "tpu_v5e_16": {"accelerator_type": "v5litepod-16", "topology": "4x4",
+                   "hosts": 4, "resources": {"TPU": 4.0, "CPU": 8.0},
+                   "min_workers": 0, "max_workers": 2},
+}
+
+
+class _RecordingQR:
+    """QueuedResourceAPI double that records calls without provisioning."""
+
+    def __init__(self):
+        self.created = []
+        self.deleted = []
+
+    def create(self, name, accelerator_type, topology, num_hosts):
+        self.created.append((name, accelerator_type, topology, num_hosts))
+        return name
+
+    def status(self, request_id):
+        return {"state": "ACTIVE", "hosts": []}
+
+    def delete(self, request_id):
+        self.deleted.append(request_id)
+
+
+def test_slice_granularity_unit():
+    """Scale-up granularity is a whole slice: 4 x {TPU:4} bundles need
+    exactly ONE v5e-16 slice (4 hosts), not 4 independent nodes; a 5th
+    bundle tips to a second slice; a {TPU:16} bundle fits no single host
+    and is infeasible."""
+    from ray_tpu.autoscaler import StandardAutoscaler, TpuPodProvider
+
+    api = _RecordingQR()
+    provider = TpuPodProvider(api, SLICE_TYPES)
+    scaler = StandardAutoscaler(provider, SLICE_TYPES)
+
+    out = scaler.update(load={
+        "nodes": [],
+        "pending_demand": [{"bundle": {"TPU": 4.0}, "count": 4}],
+    })
+    assert out["launched"] == {"tpu_v5e_16": 1}
+    assert len(api.created) == 1
+    name, acc, topo, hosts = api.created[0]
+    assert (acc, topo, hosts) == ("v5litepod-16", "4x4", 4)
+
+    # 5 bundles: one slice absorbs 4, the 5th needs a second slice.
+    api2 = _RecordingQR()
+    scaler2 = StandardAutoscaler(TpuPodProvider(api2, SLICE_TYPES),
+                                 SLICE_TYPES)
+    out = scaler2.update(load={
+        "nodes": [],
+        "pending_demand": [{"bundle": {"TPU": 4.0}, "count": 5}],
+    })
+    assert out["launched"] == {"tpu_v5e_16": 2}
+
+    # A bundle bigger than one host is infeasible on this type.
+    api3 = _RecordingQR()
+    scaler3 = StandardAutoscaler(TpuPodProvider(api3, SLICE_TYPES),
+                                 SLICE_TYPES)
+    out = scaler3.update(load={
+        "nodes": [], "pending_demand": [{"TPU": 16.0}],
+    })
+    assert out["launched"] == {}
+
+
+def test_autoscaler_e2e_tpu_pod_pg(ray_start_cluster):
+    """Pending TPU placement-group demand launches ONE fake v5e-16
+    multi-host slice (4 raylets join together) and the PG packs its
+    bundles onto the slice's hosts."""
+    from ray_tpu.autoscaler import (FakeQueuedResourceAPI,
+                                    StandardAutoscaler, TpuPodProvider)
+    from ray_tpu.util.placement_group import placement_group
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)  # head: no TPUs
+    ray_tpu.init(address=cluster.address)
+
+    api = FakeQueuedResourceAPI(
+        "127.0.0.1", cluster.head.gcs_port, cluster.head.session_dir,
+        resources_per_host={"v5litepod-16": {"TPU": 4.0, "CPU": 8.0}},
+    )
+    provider = TpuPodProvider(api, SLICE_TYPES)
+    scaler = StandardAutoscaler(
+        provider, SLICE_TYPES, gcs_address=cluster.address,
+        idle_timeout_s=3600.0,
+    )
+    try:
+        pg = placement_group([{"TPU": 4.0}] * 4, strategy="PACK")
+
+        deadline = time.monotonic() + 60
+        launched = {}
+        while time.monotonic() < deadline and not launched:
+            time.sleep(1.0)
+            launched = scaler.update()["launched"]
+        assert launched.get("tpu_v5e_16") == 1, launched
+
+        assert pg.wait(timeout_seconds=120), "PG not ready on new slice"
+
+        # Every bundle landed on a host of the ONE slice we launched
+        # (committed placement from the PG table; running tasks on all 4
+        # cold hosts would just measure worker spawn on this 1-core box).
+        from ray_tpu.util import state as state_api
+
+        table = state_api.list_placement_groups()
+        mine = [t for t in table if t["placement_group_id"] == pg.id_hex]
+        assert mine and mine[0]["state"] == "CREATED"
+        bundle_nodes = mine[0]["bundle_nodes"]
+        assert len(bundle_nodes) == 4
+        labels = {n["node_id"]: n.get("labels", {})
+                  for n in ray_tpu.nodes()}
+        slices = {labels[nid].get("tpu-slice") for nid in bundle_nodes}
+        assert len(slices) == 1 and None not in slices, slices
+        assert len(set(bundle_nodes)) == 4  # one bundle per host
+
+        # And a PG-scheduled task actually executes on the slice
+        # (num_cpus=0: the bundles reserve only TPU, and a task may not
+        # demand resources its bundle never committed — ray semantics).
+        @ray_tpu.remote(resources={"TPU": 1.0}, num_cpus=0)
+        def where():
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+        node = ray_tpu.get(
+            where.options(scheduling_strategy=None, placement_group=pg,
+                          placement_group_bundle_index=0).remote(),
+            timeout=180,
+        )
+        assert node in bundle_nodes
+    finally:
+        provider.shutdown()
