@@ -160,9 +160,18 @@ def shutdown():
         ray_tpu.get(controller.shutdown.remote(), timeout=60)
     except Exception:
         pass
+    # Belt and braces: if controller.shutdown timed out before its
+    # _stop_proxies ran, killing the controller would leak the fleet
+    # (child actors are not reaped with their parent) — sweep the
+    # per-node proxy names directly.
     try:
-        proxy = ray_tpu.get_actor("SERVE_PROXY")
-        ray_tpu.kill(proxy)
+        for n in ray_tpu.nodes():
+            try:
+                ray_tpu.kill(
+                    ray_tpu.get_actor(f"SERVE_PROXY:{n['node_id'][:12]}")
+                )
+            except Exception:
+                pass
     except Exception:
         pass
     try:

@@ -68,8 +68,11 @@ class ServeController:
         self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
-        self._proxy = None
-        self._proxy_port: Optional[int] = None
+        # proxy fleet: node_id -> {"name", "handle", "port", "grpc_port"}
+        self._proxies: Dict[str, dict] = {}
+        self._proxy_cfg: Optional[dict] = None
+        # serializes fleet reconciliation (ensure_proxy vs control loop)
+        self._proxy_reconcile_lock = threading.Lock()
         self._loop_thread = threading.Thread(
             target=self._control_loop, daemon=True
         )
@@ -199,6 +202,7 @@ class ServeController:
             apps = list(self._apps)
         for app in apps:
             self.delete_app(app)
+        self._stop_proxies()
         return True
 
     # ------------------------------------------------------------------
@@ -227,6 +231,7 @@ class ServeController:
         while not self._shutdown.is_set():
             try:
                 self._reconcile_once()
+                self._reconcile_proxies()
                 self._collect_loads()
                 self._autoscale_once()
             except Exception:  # noqa: BLE001 — loop must survive
@@ -427,20 +432,163 @@ class ServeController:
         st.draining.clear()
 
     # ------------------------------------------------------------------
-    # HTTP proxy management
+    # proxy fleet management (ray parity: serve/_private/proxy_state.py
+    # ProxyStateManager — one ProxyActor per alive node, HTTP + gRPC)
     # ------------------------------------------------------------------
     def ensure_proxy(self, host: str, port: int) -> int:
+        """Start (or reconcile) one proxy per alive node; returns the
+        head/first proxy's HTTP port for serve.start compat."""
         import ray_tpu
 
         with self._lock:
-            if self._proxy is not None:
-                return self._proxy_port
-            from ray_tpu.serve.proxy import HTTPProxy
+            started = self._proxy_cfg is not None
+            self._proxy_cfg = {"host": host, "port": port}
+            if started and self._proxies:
+                # fast path: the control loop maintains the fleet; don't
+                # make every serve.run pay a full reconcile pass
+                me = ray_tpu.get_runtime_context().get_node_id()
+                entry = self._proxies.get(me) \
+                    or next(iter(self._proxies.values()))
+                return entry["port"]
+        # BLOCK on the reconcile lock: a control-loop pass may be mid-
+        # flight — waiting for it (or running our own pass) is what makes
+        # serve.start deterministic
+        self._reconcile_proxies(block=True)
+        with self._lock:
+            if not self._proxies:
+                raise RuntimeError("no serve proxy could be started")
+            me = ray_tpu.get_runtime_context().get_node_id()
+            entry = self._proxies.get(me) or next(iter(self._proxies.values()))
+            return entry["port"]
 
-            proxy_cls = ray_tpu.remote(num_cpus=0, name="SERVE_PROXY",
-                                       max_concurrency=1000)(HTTPProxy)
-            self._proxy = proxy_cls.remote(host, port)
-            self._proxy_port = ray_tpu.get(
-                self._proxy.ready.remote(), timeout=60
+    def get_proxies(self) -> Dict[str, dict]:
+        """node_id -> {"name", "port", "grpc_port"} for every live proxy."""
+        with self._lock:
+            return {
+                nid: {k: e[k] for k in ("name", "port", "grpc_port")}
+                for nid, e in self._proxies.items()
+            }
+
+    def _reconcile_proxies(self, block: bool = False):
+        """One proxy actor per alive node: start missing ones (node joins,
+        proxy crashes), drop records of dead nodes. Runs from ensure_proxy
+        (blocking) and every control-loop pass (skipped if one is already
+        running) once a fleet is requested."""
+        with self._lock:
+            cfg = getattr(self, "_proxy_cfg", None)
+        if cfg is None:
+            return
+        if not self._proxy_reconcile_lock.acquire(blocking=block):
+            return
+        try:
+            self._reconcile_proxies_locked(cfg)
+        finally:
+            self._proxy_reconcile_lock.release()
+
+    def _reconcile_proxies_locked(self, cfg: dict):
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        try:
+            nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+        except Exception:
+            return
+        alive_ids = {n["node_id"] for n in nodes}
+        pinged = {}
+        with self._lock:
+            for nid in list(self._proxies):
+                if nid not in alive_ids:
+                    del self._proxies[nid]
+                    continue
+                try:
+                    pinged[nid] = self._proxies[nid]["handle"].ready.remote()
+                except Exception:
+                    # submission itself failed: the actor is gone
+                    del self._proxies[nid]
+        # liveness pings fan out with ONE shared deadline — a wedged
+        # proxy must not stall the pass 10s per node. An errored ref (the
+        # proxy actor died) counts as "ready" to wait(), so confirm each
+        # ready ping with a cheap get.
+        if pinged:
+            ready, _ = ray_tpu.wait(
+                list(pinged.values()), num_returns=len(pinged), timeout=10
             )
-            return self._proxy_port
+            ready_set = {r.binary() for r in ready}
+            for nid, ref in pinged.items():
+                ok = False
+                if ref.binary() in ready_set:
+                    try:
+                        ray_tpu.get(ref, timeout=5)
+                        ok = True
+                    except Exception:
+                        ok = False
+                if not ok:
+                    with self._lock:
+                        self._proxies.pop(nid, None)
+        from ray_tpu.serve.proxy import HTTPProxy
+
+        started = []  # (nid, name, handle)
+        for n in nodes:
+            nid = n["node_id"]
+            with self._lock:
+                if (nid in self._proxies or self._proxy_cfg is None
+                        or self._shutdown.is_set()):
+                    continue
+            name = f"SERVE_PROXY:{nid[:12]}"
+            try:
+                try:
+                    proxy_cls = ray_tpu.remote(
+                        num_cpus=0, name=name, max_concurrency=1000,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=nid, soft=False
+                        ),
+                    )(HTTPProxy)
+                    handle = proxy_cls.remote(cfg["host"], cfg["port"])
+                except ValueError:
+                    # name taken: an earlier pass (or a controller
+                    # restart) already created it — adopt it
+                    handle = ray_tpu.get_actor(name)
+            except Exception:
+                logger.exception("failed to create serve proxy on node %s",
+                                 nid[:12])
+                continue
+            started.append((nid, name, handle))
+        # readiness waits fan out too (shared deadline across the fleet)
+        for nid, name, handle in started:
+            try:
+                port = ray_tpu.get(handle.ready.remote(), timeout=60)
+                grpc_port = ray_tpu.get(handle.grpc_port.remote(), timeout=30)
+            except Exception:
+                logger.exception("serve proxy on node %s failed to become "
+                                 "ready", nid[:12])
+                continue
+            with self._lock:
+                if self._proxy_cfg is None or self._shutdown.is_set():
+                    # shutdown raced us: don't leak the fresh proxy
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+                    continue
+                self._proxies[nid] = {
+                    "name": name, "handle": handle, "port": port,
+                    "grpc_port": grpc_port,
+                }
+
+    def _stop_proxies(self):
+        import ray_tpu
+
+        # hold the reconcile lock so an in-flight pass can't register a
+        # fresh proxy after we clear the fleet
+        with self._proxy_reconcile_lock:
+            with self._lock:
+                entries = list(self._proxies.values())
+                self._proxies.clear()
+                self._proxy_cfg = None
+            for e in entries:
+                try:
+                    ray_tpu.kill(e["handle"])
+                except Exception:
+                    pass

@@ -25,7 +25,13 @@ _ROUTE_POLL_TTL_UNPUSHED_S = 1.0
 
 
 class HTTPProxy:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    """Per-node ingress actor hosting BOTH protocol servers (ray parity:
+    one ProxyActor per node runs the HTTP and gRPC proxies side by side,
+    serve/_private/proxy.py): aiohttp for HTTP and a generic grpc server
+    for gRPC, sharing one routing table and handle cache."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 grpc_port: Optional[int] = 0):
         import concurrent.futures
 
         self._host = host
@@ -43,12 +49,165 @@ class HTTPProxy:
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        self._grpc_actual_port: Optional[int] = None
+        if grpc_port is not None:
+            self._start_grpc(host, grpc_port)
         self._subscribe_push()
 
     def ready(self) -> int:
         self._ready.wait(timeout=30)
         assert self._actual_port is not None, "proxy failed to bind"
         return self._actual_port
+
+    def grpc_port(self) -> Optional[int]:
+        return self._grpc_actual_port
+
+    def node_id(self) -> str:
+        import ray_tpu
+
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # ------------------------------------------------------------------
+    # gRPC ingress (ray parity: serve/_private/grpc_util.py + the gRPC
+    # proxy in serve/_private/proxy.py; drivers.py gRPCIngress). A
+    # GENERIC handler serves /ray_tpu.serve.Ingress/Call for any app:
+    # request bytes are pickled (args, kwargs) or raw bytes, the target
+    # app comes from the "application" metadata key (falling back to the
+    # root route), and the reply is the pickled handler result.
+    # ------------------------------------------------------------------
+    def _start_grpc(self, host: str, port: int):
+        try:
+            import grpc
+        except Exception:
+            return  # image without grpcio: HTTP-only proxy
+        import concurrent.futures as cf
+
+        outer = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, hcd):
+                def unary(request_bytes, context):
+                    meta = dict(context.invocation_metadata() or ())
+                    try:
+                        return outer._grpc_call(
+                            hcd.method, meta, request_bytes
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            f"{type(e).__name__}: {e}",
+                        )
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+
+        server = grpc.server(cf.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="serve-grpc"
+        ))
+        server.add_generic_rpc_handlers((_Handler(),))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if bound == 0 and port != 0:
+            bound = server.add_insecure_port(f"{host}:0")
+        server.start()
+        self._grpc_server = server
+        self._grpc_actual_port = bound
+
+    def _grpc_call(self, method: str, meta: dict, request_bytes: bytes):
+        import pickle
+
+        import ray_tpu
+
+        # route: "application" metadata first, else the app at "/"
+        app_name = meta.get("application")
+
+        def find_target():
+            if app_name:
+                for _prefix, (app, ingress) in self._routes.items():
+                    if app == app_name:
+                        return (app, ingress)
+                return None
+            m = self._match("/")
+            return m[1] if m else None
+
+        self._refresh_routes_sync()
+        target = find_target()
+        if target is None:
+            # just deployed and the push was lost: force one refresh
+            # before failing (mirrors the HTTP handler's 404 path)
+            self._refresh_routes_sync(force=True)
+            target = find_target()
+        if target is None:
+            raise KeyError(f"no serve app for {app_name or '/'}")
+        handle = self._handles.get(target)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(target[1], target[0])
+            self._handles[target] = handle
+        try:
+            payload = pickle.loads(request_bytes)
+        except Exception:
+            payload = ((request_bytes,), {})
+        if (isinstance(payload, tuple) and len(payload) == 2
+                and isinstance(payload[0], tuple)
+                and isinstance(payload[1], dict)):
+            args, kwargs = payload
+        else:
+            args, kwargs = (payload,), {}
+        call_method = meta.get("method")
+        h = getattr(handle, call_method) if call_method else handle
+        result = ray_tpu.get(h.remote(*args, **kwargs).ref, timeout=60)
+        from ray_tpu.serve.replica import STREAM_MARKER
+
+        if isinstance(result, dict) and STREAM_MARKER in result:
+            # generator deployment: unary gRPC drains the whole stream
+            # and returns the concatenated output (never the internal
+            # stream marker)
+            result = self._drain_stream(result[STREAM_MARKER])
+        return pickle.dumps(result)
+
+    def _drain_stream(self, info: dict):
+        import ray_tpu
+
+        replica = ray_tpu.get_actor(info["replica"])
+        sid = info["stream_id"]
+        out = []
+        try:
+            while True:
+                items, done = ray_tpu.get(
+                    replica.next_chunks.remote(sid), timeout=60
+                )
+                out.extend(items)
+                if done:
+                    break
+        except Exception:
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+            raise
+        if out and all(isinstance(i, bytes) for i in out):
+            return b"".join(out)
+        if out and all(isinstance(i, str) for i in out):
+            return "".join(out)
+        return out
+
+    def _refresh_routes_sync(self, force: bool = False):
+        import time
+
+        import ray_tpu
+
+        self._subscribe_push()
+        ttl = _ROUTE_POLL_TTL_S if self._push_subscribed else \
+            _ROUTE_POLL_TTL_UNPUSHED_S
+        if not force and time.monotonic() - self._routes_fetched_at < ttl:
+            return
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        self._routes = ray_tpu.get(controller.get_routes.remote(), timeout=10)
+        self._routes_fetched_at = time.monotonic()
 
     # ------------------------------------------------------------------
     def _serve(self):

@@ -1,0 +1,95 @@
+"""Per-node proxy fleet + gRPC ingress (analog of ray:
+serve/_private/proxy_state.py tests + test_grpc proxy tests)."""
+
+import time
+
+import pytest
+import requests
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def _controller():
+    return ray_tpu.get_actor("SERVE_CONTROLLER")
+
+
+def test_proxy_fleet_multi_node_and_grpc(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)  # becomes the head node
+    cluster.add_node(num_cpus=2, resources={"nodeB": 1.0})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    serve.start()
+    try:
+        @serve.deployment(num_replicas=1)
+        class Echo:
+            def __call__(self, arg):
+                if isinstance(arg, serve.Request):
+                    return {"path": arg.path}
+                return {"echo": arg}
+
+        serve.run(Echo.bind(), name="default", route_prefix="/")
+
+        # One proxy per alive node.
+        ctrl = _controller()
+        deadline = time.monotonic() + 60
+        proxies = {}
+        while time.monotonic() < deadline and len(proxies) < 2:
+            proxies = ray_tpu.get(ctrl.get_proxies.remote(), timeout=30)
+            time.sleep(1.0)
+        assert len(proxies) == 2, proxies
+
+        # Requests through EVERY node's proxy reach the app.
+        for nid, info in proxies.items():
+            r = requests.get(f"http://127.0.0.1:{info['port']}/ping",
+                             timeout=30)
+            assert r.status_code == 200, (nid, r.text)
+            assert r.json()["path"] == "/ping"
+
+        # gRPC ingress on each proxy: pickled (args, kwargs) in, pickled
+        # result out, routed by "application" metadata.
+        import pickle
+
+        import grpc
+
+        info = next(iter(proxies.values()))
+        assert info["grpc_port"], info
+        channel = grpc.insecure_channel(f"127.0.0.1:{info['grpc_port']}")
+        call = channel.unary_unary(
+            "/ray_tpu.serve.Ingress/Call",
+            request_serializer=None, response_deserializer=None,
+        )
+        reply = call(pickle.dumps((("hello-grpc",), {})),
+                     metadata=(("application", "default"),), timeout=60)
+        assert pickle.loads(reply) == {"echo": "hello-grpc"}
+        channel.close()
+
+        # Kill one proxy: the app stays reachable through the OTHER
+        # proxy, and the controller restarts the dead one.
+        victim_nid, victim = next(iter(proxies.items()))
+        other = [v for k, v in proxies.items() if k != victim_nid][0]
+        ray_tpu.kill(ray_tpu.get_actor(victim["name"]))
+        r = requests.get(f"http://127.0.0.1:{other['port']}/alive",
+                         timeout=30)
+        assert r.status_code == 200
+
+        deadline = time.monotonic() + 90
+        revived = None
+        while time.monotonic() < deadline:
+            cur = ray_tpu.get(ctrl.get_proxies.remote(), timeout=30)
+            ent = cur.get(victim_nid)
+            if ent is not None:
+                try:
+                    r = requests.get(
+                        f"http://127.0.0.1:{ent['port']}/back", timeout=10
+                    )
+                    if r.status_code == 200:
+                        revived = ent
+                        break
+                except Exception:
+                    pass
+            time.sleep(1.5)
+        assert revived is not None, "killed proxy was not restarted"
+    finally:
+        serve.shutdown()
