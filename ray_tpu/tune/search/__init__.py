@@ -15,6 +15,8 @@ from ray_tpu.tune.search.sample import (
     uniform,
 )
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Repeater, Searcher
+from ray_tpu.tune.search.bohb import BOHBSearcher
+from ray_tpu.tune.search.external import ExternalSearcherAdapter, OptunaSearch
 from ray_tpu.tune.search.tpe import TPESearcher
 
 __all__ = [
@@ -23,6 +25,9 @@ __all__ = [
     "Repeater",
     "Searcher",
     "TPESearcher",
+    "BOHBSearcher",
+    "ExternalSearcherAdapter",
+    "OptunaSearch",
     "choice",
     "grid_search",
     "lograndint",
