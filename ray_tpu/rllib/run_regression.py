@@ -1,0 +1,152 @@
+"""RLlib learning-regression runner.
+
+Reference parity: ray rllib/tests/run_regression_tests.py + the
+rllib/tuned_examples/ config registry — per-algorithm YAML files declare
+an environment, a training config, and a stop block with a reward
+threshold; one command runs every config and fails if any algorithm
+stops learning.
+
+Usage::
+
+    python -m ray_tpu.rllib.run_regression            # all configs
+    python -m ray_tpu.rllib.run_regression --select ppo
+    python -m ray_tpu.rllib.run_regression --dir my_configs/
+
+Config shape (one or more experiments per file)::
+
+    cartpole-ppo:
+      algorithm: PPO           # <Name>Config looked up in ray_tpu.rllib
+      env: CartPole-native
+      stop:
+        episode_return_mean: 100.0   # pass threshold (required)
+        training_iteration: 30       # iteration budget (required)
+      config:                  # sections = AlgorithmConfig builder calls
+        env_runners: {num_env_runners: 2}
+        training: {lr: 0.005}
+        learners: {num_learners: 2}
+        debugging: {seed: 0}
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+from typing import Dict, List
+
+TUNED_EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "tuned_examples")
+
+
+def load_experiments(directory: str, select: str = "") -> Dict[str, dict]:
+    import yaml
+
+    out: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(directory, "*.yaml"))):
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        for name, spec in doc.items():
+            if select and select not in name:
+                continue
+            out[name] = spec
+    return out
+
+
+def build_algorithm(spec: dict):
+    import ray_tpu.rllib as rllib
+
+    algo_name = spec["algorithm"]
+    config_cls = getattr(rllib, f"{algo_name}Config", None)
+    if config_cls is None:
+        raise ValueError(f"unknown algorithm {algo_name!r}")
+    config = config_cls().environment(spec["env"])
+    for section, kwargs in (spec.get("config") or {}).items():
+        method = getattr(config, section, None)
+        if method is None:
+            raise ValueError(
+                f"{algo_name}Config has no section {section!r}"
+            )
+        config = method(**kwargs)
+    return config.build()
+
+
+def run_experiment(name: str, spec: dict) -> dict:
+    stop = spec.get("stop") or {}
+    threshold = stop.get("episode_return_mean")
+    max_iters = int(stop.get("training_iteration", 50))
+    algo = build_algorithm(spec)
+    best = float("-inf")
+    iters = 0
+    t0 = time.monotonic()
+    try:
+        for iters in range(1, max_iters + 1):
+            result = algo.train()
+            r = result.get("episode_return_mean")
+            if r is not None:
+                best = max(best, r)
+            if threshold is not None and best >= threshold:
+                break
+    finally:
+        algo.stop()
+    passed = threshold is None or best >= threshold
+    return {
+        "name": name, "passed": passed, "best": best,
+        "threshold": threshold, "iterations": iters,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--select", default="",
+                        help="substring filter on experiment names")
+    parser.add_argument("--dir", default=TUNED_EXAMPLES_DIR,
+                        help="directory of tuned-example YAMLs")
+    parser.add_argument("--num-cpus", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    experiments = load_experiments(args.dir, args.select)
+    if not experiments:
+        print(f"no experiments matched --select {args.select!r} "
+              f"in {args.dir}")
+        return 2
+
+    # CartPole-scale regressions are a CPU workload; more importantly, an
+    # ambient JAX_PLATFORMS pointing at a TPU tunnel that is down hangs
+    # jax backend init forever. Pin CPU unless explicitly overridden.
+    if os.environ.get("RAY_TPU_REGRESSION_PLATFORM", "cpu") == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from ray_tpu._private.jax_pin import _pin_jax_platform_on_import
+
+        _pin_jax_platform_on_import("cpu")
+
+    import ray_tpu
+
+    started_here = not ray_tpu.is_initialized()
+    if started_here:
+        ray_tpu.init(num_cpus=args.num_cpus)
+    results = []
+    try:
+        for name, spec in experiments.items():
+            print(f"== {name} ({spec['algorithm']} on {spec['env']})",
+                  flush=True)
+            res = run_experiment(name, spec)
+            results.append(res)
+            status = "PASS" if res["passed"] else "FAIL"
+            print(f"   {status}: best={res['best']:.1f} "
+                  f"threshold={res['threshold']} "
+                  f"iters={res['iterations']} ({res['wall_s']}s)",
+                  flush=True)
+    finally:
+        if started_here:
+            ray_tpu.shutdown()
+
+    failed = [r for r in results if not r["passed"]]
+    print(f"\n{len(results) - len(failed)}/{len(results)} regression "
+          f"configs passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

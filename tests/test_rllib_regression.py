@@ -1,0 +1,24 @@
+"""The tuned-examples regression harness itself (ray parity:
+rllib/tests/run_regression_tests.py driven in CI)."""
+
+import subprocess
+import sys
+
+
+def test_run_regression_all_configs():
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.rllib.run_regression"],
+        capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "3/3 regression configs passed" in out.stdout, out.stdout
+
+
+def test_select_filter_and_missing():
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.rllib.run_regression",
+         "--select", "no-such-config"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 2
+    assert "no experiments matched" in out.stdout
