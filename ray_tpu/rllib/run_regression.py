@@ -49,6 +49,11 @@ def load_experiments(directory: str, select: str = "") -> Dict[str, dict]:
         for name, spec in doc.items():
             if select and select not in name:
                 continue
+            if name in out:
+                raise ValueError(
+                    f"duplicate experiment name {name!r} in {path}; a "
+                    "silent overwrite would drop a regression config"
+                )
             out[name] = spec
     return out
 
@@ -63,10 +68,18 @@ def build_algorithm(spec: dict):
     config = config_cls().environment(spec["env"])
     for section, kwargs in (spec.get("config") or {}).items():
         method = getattr(config, section, None)
-        if method is None:
+        if method is None or not callable(method):
             raise ValueError(
-                f"{algo_name}Config has no section {section!r}"
+                f"{algo_name}Config has no builder section {section!r}"
             )
+        # the fluent builders silently drop unknown kwargs; a typoed
+        # hyperparameter would test defaults while looking tuned
+        for key in kwargs:
+            if not hasattr(config, key) and section == "training":
+                raise ValueError(
+                    f"{algo_name}Config.{section}() does not know "
+                    f"{key!r} (typo in the tuned-example config?)"
+                )
         config = method(**kwargs)
     return config.build()
 
@@ -74,6 +87,13 @@ def build_algorithm(spec: dict):
 def run_experiment(name: str, spec: dict) -> dict:
     stop = spec.get("stop") or {}
     threshold = stop.get("episode_return_mean")
+    if threshold is None:
+        # a missing/misspelled threshold must not silently auto-pass:
+        # this harness exists to catch learning regressions
+        raise ValueError(
+            f"experiment {name!r} has no stop.episode_return_mean "
+            f"threshold (found stop keys: {sorted(stop)})"
+        )
     max_iters = int(stop.get("training_iteration", 50))
     algo = build_algorithm(spec)
     best = float("-inf")
@@ -85,11 +105,11 @@ def run_experiment(name: str, spec: dict) -> dict:
             r = result.get("episode_return_mean")
             if r is not None:
                 best = max(best, r)
-            if threshold is not None and best >= threshold:
+            if best >= threshold:
                 break
     finally:
         algo.stop()
-    passed = threshold is None or best >= threshold
+    passed = best >= threshold
     return {
         "name": name, "passed": passed, "best": best,
         "threshold": threshold, "iterations": iters,
