@@ -23,6 +23,11 @@ from ray_tpu.data.read_api import (
     read_tfrecords,
     read_webdataset,
 )
+from ray_tpu.data.datasource_api import (
+    Datasource,
+    FileBasedDatasource,
+    read_datasource,
+)
 from ray_tpu.data import preprocessors
 
 __all__ = [
@@ -46,6 +51,9 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_sql",
+    "read_datasource",
+    "Datasource",
+    "FileBasedDatasource",
     "read_tfrecords",
     "read_webdataset",
     "preprocessors",

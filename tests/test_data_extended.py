@@ -154,3 +154,56 @@ def test_label_onehot_imputer_concat_chain(ray_start_regular):
 
     with pytest.raises(pp.PreprocessorNotFittedError):
         pp.StandardScaler(["x"]).transform(ds)
+
+
+def test_custom_file_based_datasource(ray_start_regular, tmp_path):
+    """The docstring's worked example: a length-prefixed record format
+    plugged in via FileBasedDatasource + read_datasource."""
+    from ray_tpu.data import FileBasedDatasource, read_datasource
+
+    for shard in range(3):
+        with open(tmp_path / f"part-{shard}.rec", "wb") as f:
+            for i in range(4):
+                payload = f"s{shard}r{i}".encode()
+                f.write(len(payload).to_bytes(4, "little"))
+                f.write(payload)
+    (tmp_path / "ignored.txt").write_text("not a rec file")
+
+    class RecordDatasource(FileBasedDatasource):
+        _FILE_EXTENSIONS = ["rec"]
+
+        def _read_file(self, f, path):
+            rows = []
+            while True:
+                hdr = f.read(4)
+                if not hdr:
+                    break
+                n = int.from_bytes(hdr, "little")
+                rows.append({"payload": f.read(n)})
+            return rows
+
+    ds = read_datasource(RecordDatasource(str(tmp_path)), parallelism=2)
+    rows = ds.take_all()
+    assert len(rows) == 12
+    assert {r["payload"] for r in rows} == {
+        f"s{s}r{i}".encode() for s in range(3) for i in range(4)
+    }
+    # streams through the executor like any built-in reader
+    assert ds.map(lambda r: {"n": len(r["payload"])}).take_all()[0]["n"] == 4
+
+
+def test_custom_datasource_base(ray_start_regular):
+    """Bare Datasource contract: synthesize blocks without files."""
+    from ray_tpu.data import Datasource, read_datasource
+
+    class Squares(Datasource):
+        def get_read_tasks(self, parallelism):
+            def make(lo, hi):
+                return lambda: [{"x": i, "sq": i * i}
+                                for i in range(lo, hi)]
+            step = 10
+            return [make(i, i + step) for i in range(0, 30, step)]
+
+    rows = read_datasource(Squares()).take_all()
+    assert len(rows) == 30
+    assert all(r["sq"] == r["x"] ** 2 for r in rows)
