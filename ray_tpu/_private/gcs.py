@@ -135,7 +135,8 @@ class PlacementGroupRecord:
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 cluster_id: Optional[str] = None):
         from ray_tpu._private.gcs_store import make_store
 
         self.server = RpcServer(self, host, port)
@@ -160,7 +161,7 @@ class GcsServer:
         # structured cluster events (ray parity: src/ray/util/event.h:130 —
         # severity/source/label/message + custom fields), bounded ring
         self.events: deque = deque(maxlen=10_000)
-        self._store = make_store(persist_path)
+        self._store = make_store(persist_path, cluster_id=cluster_id)
         self._recovering: Set[bytes] = set()  # actor_ids awaiting raylet reclaim
         self._recovered = self._replay()
 

@@ -13,7 +13,8 @@ async def amain(args):
     from ray_tpu._private.gcs import GcsServer
 
     server = GcsServer(host=args.host, port=args.port,
-                       persist_path=args.persist_path)
+                       persist_path=args.persist_path,
+                       cluster_id=args.cluster_id)
     port = await server.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
@@ -31,6 +32,7 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--port-file", default=None)
+    parser.add_argument("--cluster-id", default=None)
     parser.add_argument("--persist-path", default=None,
                         help="append-log file enabling GCS fault tolerance")
     args = parser.parse_args()

@@ -182,11 +182,118 @@ class NativeLogStore:
             self._h = None
 
 
-def make_store(persist_path: Optional[str]):
-    """Native C++ log store when the library loads, Python fallback
-    otherwise (both replay + compact; formats are store-private)."""
+class SqliteStore:
+    """Durable external storage backend (reference analog: the
+    RedisStoreClient role, src/ray/gcs/store_client/redis_store_client.h
+    — GCS tables live in a store that outlives the GCS process). Point
+    it at LOCAL persistent disk outside the session dir and head-node
+    session loss no longer loses cluster metadata. Do NOT put the file
+    on NFS or similar network filesystems: SQLite's WAL mode needs
+    shared memory and network-FS locking is unreliable — for
+    network-attached durability, drop a Redis/etcd client behind the
+    same load/put/close interface instead.
+
+    Selected with a ``sqlite://<path>`` persist path (see make_store).
+    WAL mode with synchronous=FULL: every commit is fsync'd — this
+    store exists for the machine-loss case, not just process loss.
+
+    ``cluster_id`` scopes ownership: reopening the DB from a DIFFERENT
+    cluster wipes the previous cluster's state instead of resurrecting
+    its actors/jobs into the new one (a restarted GCS of the SAME
+    cluster replays normally).
+    """
+
+    def __init__(self, path: str, cluster_id: Optional[str] = None):
+        import sqlite3
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=FULL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS gcs_kv ("
+            " tbl TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (tbl, key))"
+        )
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS gcs_meta ("
+            " key TEXT PRIMARY KEY, value TEXT)"
+        )
+        self._db.commit()
+        if cluster_id:
+            row = self._db.execute(
+                "SELECT value FROM gcs_meta WHERE key='cluster_id'"
+            ).fetchone()
+            if row is not None and row[0] != cluster_id:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "sqlite GCS store %s belonged to cluster %s; wiping "
+                    "its state for new cluster %s", path, row[0], cluster_id,
+                )
+                self._db.execute("DELETE FROM gcs_kv")
+            self._db.execute(
+                "INSERT OR REPLACE INTO gcs_meta (key, value) "
+                "VALUES ('cluster_id', ?)", (cluster_id,)
+            )
+            self._db.commit()
+
+    def load(self) -> Dict[str, dict]:
+        tables: Dict[str, dict] = {}
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT tbl, key, value FROM gcs_kv"
+            ).fetchall()
+        for tbl, key, value in rows:
+            tables.setdefault(tbl, {})[pickle.loads(key)] = \
+                pickle.loads(value)
+        return tables
+
+    def put(self, table: str, key, value) -> None:
+        kb = pickle.dumps(key, protocol=5)
+        with self._lock:
+            if value is None:
+                self._db.execute(
+                    "DELETE FROM gcs_kv WHERE tbl=? AND key=?", (table, kb)
+                )
+            else:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO gcs_kv (tbl, key, value) "
+                    "VALUES (?, ?, ?)",
+                    (table, kb, pickle.dumps(value, protocol=5)),
+                )
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._db.close()
+            except Exception:
+                pass
+
+
+def make_store(persist_path: Optional[str],
+               cluster_id: Optional[str] = None):
+    """Backend selection by scheme:
+
+    - ``None``/empty        -> NullStore (in-memory, nothing survives)
+    - ``sqlite://<path>``   -> SqliteStore (durable external store)
+    - plain path            -> native C++ log store when the library
+      loads, Python append-log fallback otherwise
+
+    ``RAY_TPU_GCS_STORAGE`` overrides the configured path wholesale, so
+    an operator can point an existing deployment at durable storage
+    without touching startup scripts. ``cluster_id`` (the session name)
+    keeps an external store from resurrecting a previous cluster's
+    state — session-dir log files are per-cluster by construction."""
+    persist_path = os.environ.get("RAY_TPU_GCS_STORAGE") or persist_path
     if not persist_path:
         return NullStore()
+    if persist_path.startswith("sqlite://"):
+        return SqliteStore(persist_path[len("sqlite://"):],
+                           cluster_id=cluster_id)
     try:
         from ray_tpu._private import native_store
 

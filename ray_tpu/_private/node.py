@@ -142,7 +142,8 @@ class NodeProcesses:
             self.gcs_proc = _spawn(
                 [sys.executable, "-m", "ray_tpu._private.gcs_main",
                  "--host", gcs_host, "--port", "0", "--port-file", port_file,
-                 "--persist-path", self.gcs_persist_path],
+                 "--persist-path", self.gcs_persist_path,
+                 "--cluster-id", os.path.basename(self.session_dir)],
                 os.path.join(self.logs, "gcs.out"),
                 env=dict(os.environ),
             )
@@ -195,7 +196,8 @@ class NodeProcesses:
         self.gcs_proc = _spawn(
             [sys.executable, "-m", "ray_tpu._private.gcs_main",
              "--host", self.gcs_host, "--port", str(self.gcs_port),
-             "--persist-path", self.gcs_persist_path],
+             "--persist-path", self.gcs_persist_path,
+             "--cluster-id", os.path.basename(self.session_dir)],
             os.path.join(self.logs, "gcs.out"),
             env=dict(os.environ),
         )

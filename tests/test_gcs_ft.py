@@ -181,3 +181,79 @@ def test_object_transfer_survives_gcs_outage(ray_start_cluster):
     assert ray_tpu.get(consume.remote(ref2), timeout=90) == int(
         np.arange(200_000, dtype=np.int64).sum()
     )
+
+
+def test_sqlite_store_roundtrip(tmp_path, monkeypatch):
+    """SqliteStore: upserts, tombstones, reopen-and-load, and cluster
+    ownership (a NEW cluster must not resurrect the old one's state)."""
+    monkeypatch.delenv("RAY_TPU_GCS_STORAGE", raising=False)
+    from ray_tpu._private.gcs_store import SqliteStore, make_store
+
+    path = str(tmp_path / "sub" / "gcs.sqlite")
+    st = make_store(f"sqlite://{path}")
+    assert isinstance(st, SqliteStore)
+    st.put("actors", b"a1", {"state": "ALIVE"})
+    st.put("kv", ("ns", b"k"), b"v")
+    st.put("actors", b"a2", {"state": "DEAD"})
+    st.put("actors", b"a2", None)  # tombstone
+    st.close()
+
+    st2 = SqliteStore(path)
+    tables = st2.load()
+    assert tables["actors"] == {b"a1": {"state": "ALIVE"}}
+    assert tables["kv"][("ns", b"k")] == b"v"
+    st2.close()
+
+    # same cluster id: state replays; different cluster id: wiped.
+    st3 = SqliteStore(path, cluster_id="cluster-A")
+    assert st3.load()["actors"] == {b"a1": {"state": "ALIVE"}}
+    st3.close()
+    st4 = SqliteStore(path, cluster_id="cluster-A")
+    assert st4.load()["actors"] == {b"a1": {"state": "ALIVE"}}
+    st4.close()
+    st5 = SqliteStore(path, cluster_id="cluster-B")
+    assert st5.load() == {}
+    st5.close()
+
+
+def test_gcs_kill9_restart_against_sqlite(ray_start_cluster, monkeypatch,
+                                          tmp_path):
+    """kill -9 the GCS and restart it against the EXTERNAL sqlite store:
+    jobs/actors/KV/PGs come back intact even though the session-dir log
+    was never written (reference analog: RedisStoreClient failover)."""
+    monkeypatch.setenv(
+        "RAY_TPU_GCS_STORAGE", f"sqlite://{tmp_path}/external_gcs.sqlite"
+    )
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+
+    counter = Counter.options(name="sq-survivor",
+                              lifetime="detached").remote()
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 1
+    from ray_tpu.util.collective import collective as col
+
+    col._kv_put(b"sq-key", b"sq-value")
+    # the external store is the one being written, not the session log
+    import os
+
+    assert os.path.exists(f"{tmp_path}/external_gcs.sqlite")
+
+    cluster.head.kill_gcs()  # SIGKILL, no flush opportunity
+    cluster.head.restart_gcs()
+    assert _gcs_alive(cluster.head.gcs_port)
+
+    deadline = time.monotonic() + 30
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = col._kv_get(b"sq-key")
+            if val is not None:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    assert val == b"sq-value"
+    assert ray_tpu.get(counter.incr.remote(), timeout=60) == 2
+    handle = ray_tpu.get_actor("sq-survivor")
+    assert ray_tpu.get(handle.incr.remote(), timeout=60) == 3
