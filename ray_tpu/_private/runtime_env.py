@@ -7,8 +7,10 @@ the DRIVER packages local directories into content-addressed zips stored
 in the GCS KV, rewriting the runtime_env to carry URIs; each WORKER
 materializes the URIs it needs into a node-local cache before serving
 tasks (workers are pooled per runtime-env hash, so one worker serves one
-env). pip/conda are not supported in this offline image and raise
-up front rather than failing at task time.
+env). pip IS supported offline through a local wheelhouse (see
+_PipPlugin: the wheelhouse ships content-addressed like working_dir and
+workers build a cached venv from it); conda/container are not supported
+in this image and raise up front rather than failing at task time.
 """
 
 from __future__ import annotations
@@ -238,8 +240,149 @@ class _EnvVarsPlugin(RuntimeEnvPlugin):
             raise ValueError("runtime_env['env_vars'] must be a str dict")
 
 
-for _name in ("pip", "conda", "container"):
+class _PipPlugin(RuntimeEnvPlugin):
+    """pip runtime env backed by a LOCAL WHEELHOUSE (ray parity:
+    python/ray/_private/runtime_env/pip.py, constrained to offline
+    images: no index access at task time).
+
+    Accepted forms::
+
+        runtime_env={"pip": ["mypkg", "otherpkg==1.2"]}
+        runtime_env={"pip": {"packages": [...],
+                             "wheelhouse": "/path/to/wheels"}}
+
+    The wheelhouse (the dict key, or ``RAY_TPU_WHEELHOUSE``) must be a
+    directory of pre-downloaded wheels; validation fails EARLY with a
+    clear error when none is configured, rather than at task time. The
+    driver uploads the wheelhouse as a content-addressed package to the
+    GCS KV (same plane as working_dir), so remote nodes materialize it
+    too and updated wheels change the content hash (no stale-venv
+    trap). Workers build a ``--system-site-packages`` venv per
+    (packages, wheelhouse-content) digest under the node cache —
+    atomically, via tmp-dir + rename, because concurrent same-env
+    workers race — install with ``pip --no-index --find-links``, and
+    add the venv's site-packages to ``sys.path``.
+
+    Priority 8: BEFORE working_dir/py_modules, whose later sys.path
+    prepends must shadow wheelhouse packages (user-shipped code wins
+    over installed packages, matching the reference's precedence)."""
+
+    name = "pip"
+    priority = 8
+
+    @staticmethod
+    def _normalize(env: dict):
+        spec = env.get("pip")
+        if not spec:
+            return None, None
+        if isinstance(spec, (list, tuple)):
+            packages, wheelhouse = list(spec), None
+        elif isinstance(spec, dict):
+            packages = list(spec.get("packages") or ())
+            wheelhouse = spec.get("wheelhouse")
+        else:
+            raise ValueError(
+                "runtime_env['pip'] must be a list of requirements or a "
+                "dict with 'packages' (+ optional 'wheelhouse')"
+            )
+        wheelhouse = wheelhouse or os.environ.get("RAY_TPU_WHEELHOUSE")
+        return packages, wheelhouse
+
+    def validate(self, env: dict) -> None:
+        spec = env.get("pip")
+        if isinstance(spec, dict) and spec.get("wheelhouse_uri"):
+            return  # already prepared (validate is re-run on re-prepare)
+        packages, wheelhouse = self._normalize(env)
+        if packages is None:
+            return
+        if not packages:
+            raise ValueError("runtime_env['pip'] lists no packages")
+        if not wheelhouse:
+            raise ValueError(
+                "runtime_env['pip'] needs a local wheelhouse in this "
+                "offline image: pass {'pip': {'packages': [...], "
+                "'wheelhouse': '/path/to/wheels'}} or set "
+                "RAY_TPU_WHEELHOUSE. There is no network package "
+                "installation at task time; pre-download wheels with "
+                "`pip download -d <wheelhouse> <pkgs>` on a connected "
+                "machine."
+            )
+        if not os.path.isdir(wheelhouse):
+            raise ValueError(
+                f"runtime_env['pip'] wheelhouse {wheelhouse!r} is not a "
+                "directory"
+            )
+
+    def prepare(self, core_worker, env: dict) -> None:
+        spec = env.get("pip")
+        if isinstance(spec, dict) and spec.get("wheelhouse_uri"):
+            return  # already prepared
+        packages, wheelhouse = self._normalize(env)
+        if packages is None:
+            return
+        # ship the wheelhouse content-addressed through the GCS KV: the
+        # driver-local path means nothing on other nodes, and the content
+        # hash doubles as the venv cache key (updated wheels -> new venv)
+        upload = _upload_factory(core_worker)
+        env["pip"] = {"packages": sorted(packages),
+                      "wheelhouse_uri": upload(wheelhouse)}
+
+    def materialize(self, core_worker, env: dict) -> None:
+        import shutil
+        import subprocess
+
+        spec = env.get("pip")
+        if not spec:
+            return
+        packages = list(spec.get("packages") or ())
+        uri = spec.get("wheelhouse_uri")
+        if not packages or not uri:
+            return
+        wheelhouse = _fetch_and_extract(_gcs_requester(core_worker), uri)
+        digest = hashlib.sha256(
+            repr((sorted(packages), uri)).encode()
+        ).hexdigest()[:16]
+        venv_dir = os.path.join(_cache_root(), f"pipenv_{digest}")
+        marker = os.path.join(venv_dir, ".ready")
+        if not os.path.exists(marker):
+            # build in a private tmp dir and publish with one atomic
+            # rename; a concurrent same-env worker either wins the rename
+            # or discards its build and uses the winner's
+            tmp = f"{venv_dir}.building.{os.getpid()}"
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp],
+                check=True, capture_output=True,
+            )
+            proc = subprocess.run(
+                [os.path.join(tmp, "bin", "pip"), "install", "--no-index",
+                 "--find-links", wheelhouse, *sorted(packages)],
+                capture_output=True, text=True,
+            )
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    "pip runtime_env install failed (wheelhouse "
+                    f"{wheelhouse}):\n{proc.stdout}\n{proc.stderr}"
+                )
+            with open(os.path.join(tmp, ".ready"), "w") as f:
+                f.write("ok")
+            try:
+                os.rename(tmp, venv_dir)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+        import glob as _glob
+
+        for sp in _glob.glob(
+            os.path.join(venv_dir, "lib", "python*", "site-packages")
+        ):
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+
+
+for _name in ("conda", "container"):
     register_runtime_env_plugin(_UnsupportedPlugin(_name))
+register_runtime_env_plugin(_PipPlugin())
 register_runtime_env_plugin(_EnvVarsPlugin())
 register_runtime_env_plugin(_WorkingDirPlugin())
 register_runtime_env_plugin(_PyModulesPlugin())

@@ -60,13 +60,24 @@ def test_env_vars_and_pool_isolation(ray_start_regular, tmp_path):
     assert ray_tpu.get(without_flag.remote(), timeout=120) is None
 
 
-def test_unsupported_plugins_fail_fast(ray_start_regular):
+def test_unsupported_plugins_fail_fast(ray_start_regular, monkeypatch):
+    # pip is supported WITH a wheelhouse; without one it must still fail
+    # at submission time with the documented guidance
+    monkeypatch.delenv("RAY_TPU_WHEELHOUSE", raising=False)
+
     @ray_tpu.remote(runtime_env={"pip": ["requests"]})
     def nope():
         return 1
 
-    with pytest.raises(ValueError, match="not supported"):
+    with pytest.raises(ValueError, match="wheelhouse"):
         nope.remote()
+
+    @ray_tpu.remote(runtime_env={"conda": ["whatever"]})
+    def nope2():
+        return 1
+
+    with pytest.raises(ValueError, match="not supported"):
+        nope2.remote()
 
 
 def test_actor_runtime_env(ray_start_regular, tmp_path):

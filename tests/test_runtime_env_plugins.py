@@ -60,9 +60,26 @@ def test_plugin_validate_fails_fast():
 def test_unsupported_keys_still_raise():
     from ray_tpu._private.runtime_env import prepare_runtime_env
 
-    for key in ("pip", "conda", "container"):
+    for key in ("conda", "container"):
         with pytest.raises(ValueError, match="not supported"):
             prepare_runtime_env(None, {key: ["anything"]})
+
+
+def test_pip_without_wheelhouse_raises_documented_error(monkeypatch):
+    """Offline path: pip with no wheelhouse fails EARLY with the
+    pre-download instructions, not at task time."""
+    monkeypatch.delenv("RAY_TPU_WHEELHOUSE", raising=False)
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    with pytest.raises(ValueError, match="wheelhouse"):
+        prepare_runtime_env(None, {"pip": ["somepkg"]})
+    with pytest.raises(ValueError, match="pip download"):
+        prepare_runtime_env(None, {"pip": ["somepkg"]})
+    # missing directory is also an early error
+    with pytest.raises(ValueError, match="not a directory"):
+        prepare_runtime_env(
+            None, {"pip": {"packages": ["p"], "wheelhouse": "/nope"}}
+        )
 
 
 def test_non_json_value_rejected_at_option_time():
@@ -77,3 +94,56 @@ def test_env_vars_shape_validated():
 
     with pytest.raises(ValueError, match="env_vars"):
         prepare_runtime_env(None, {"env_vars": ["not", "a", "dict"]})
+
+
+def _make_wheel(wheelhouse, name="rtpu_testwheel", version="1.0",
+                body="MAGIC = 42\n"):
+    """Hand-craft a minimal pure-Python wheel (a wheel is just a zip with
+    dist-info metadata) so the test needs no network or build tooling."""
+    import os
+    import zipfile
+
+    os.makedirs(wheelhouse, exist_ok=True)
+    whl = os.path.join(wheelhouse, f"{name}-{version}-py3-none-any.whl")
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", body)
+        zf.writestr(
+            f"{di}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        zf.writestr(
+            f"{di}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n",
+        )
+        zf.writestr(
+            f"{di}/RECORD",
+            f"{name}/__init__.py,,\n{di}/METADATA,,\n{di}/WHEEL,,\n"
+            f"{di}/RECORD,,\n",
+        )
+    return whl
+
+
+def test_pip_wheelhouse_env_end_to_end(ray_start_regular_fn, tmp_path):
+    """A task running under a pip runtime env imports a package that
+    exists ONLY as a wheel in the local wheelhouse."""
+    import ray_tpu
+
+    wheelhouse = str(tmp_path / "wheels")
+    _make_wheel(wheelhouse)
+
+    @ray_tpu.remote(runtime_env={"pip": {"packages": ["rtpu_testwheel"],
+                                         "wheelhouse": wheelhouse}})
+    def use_wheel():
+        import rtpu_testwheel
+
+        return rtpu_testwheel.MAGIC
+
+    assert ray_tpu.get(use_wheel.remote(), timeout=120) == 42
+
+    # the driver itself must NOT see the package (it lives in the
+    # worker's venv, not the shared interpreter)
+    import importlib.util
+
+    assert importlib.util.find_spec("rtpu_testwheel") is None
