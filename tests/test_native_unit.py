@@ -1,0 +1,22 @@
+"""Build + run the native C++ unit tests (src/native_test.cpp) from
+pytest so CI exercises the C ABI directly (analog of the reference's
+per-component gtest suites)."""
+
+import os
+import subprocess
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
+
+
+def test_native_cpp_unit_suite():
+    build = subprocess.run(
+        ["make", "-C", SRC, "native_test"], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert build.returncode == 0, build.stdout + build.stderr
+    run = subprocess.run(
+        [os.path.join(SRC, "native_test")], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "checks passed" in run.stdout
