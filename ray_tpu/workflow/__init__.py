@@ -15,6 +15,7 @@ API: run / run_async, resume, get_status, get_output, list_all, delete.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import pickle
 import time
@@ -22,12 +23,31 @@ import uuid
 from typing import Any, Dict, Optional
 
 from ray_tpu.dag import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow.events import (
+    EventListener,
+    EventNode,
+    TimerListener,
+    wait_for_event,
+)
+
+logger = logging.getLogger(__name__)
 
 # statuses (ray parity: workflow.WorkflowStatus)
 RUNNING = "RUNNING"
 SUCCESSFUL = "SUCCESSFUL"
 FAILED = "FAILED"
 RESUMABLE = "RESUMABLE"
+CANCELED = "CANCELED"
+
+
+class WorkflowNotFoundError(KeyError):
+    def __init__(self, workflow_id: str):
+        super().__init__(f"no workflow {workflow_id!r} in storage")
+
+
+class WorkflowCancellationError(RuntimeError):
+    def __init__(self, workflow_id: str):
+        super().__init__(f"workflow {workflow_id!r} was canceled")
 
 
 def _storage_root(storage: Optional[str] = None) -> str:
@@ -58,6 +78,8 @@ def _step_id(node: DAGNode, cache: Dict[int, str]) -> str:
         h.update(getattr(node._cls, "__name__", "cls").encode())
     elif isinstance(node, InputNode):
         h.update(b"__input__")
+    elif isinstance(node, EventNode):
+        h.update(node.name.encode())
     def feed(value):
         if isinstance(value, DAGNode):
             h.update(_step_id(value, cache).encode())
@@ -81,7 +103,8 @@ def _step_id(node: DAGNode, cache: Dict[int, str]) -> str:
 class _WorkflowRun:
     def __init__(self, workflow_id: str, storage: Optional[str]):
         self.workflow_id = workflow_id
-        self.dir = os.path.join(_storage_root(storage), workflow_id)
+        self.storage = _storage_root(storage)
+        self.dir = os.path.join(self.storage, workflow_id)
         os.makedirs(self.dir, exist_ok=True)
 
     # -- metadata ------------------------------------------------------
@@ -131,6 +154,12 @@ class _WorkflowRun:
 
         self.write_meta(status=RUNNING, owner_pid=os.getpid(),
                         owner_host=os.uname().nodename)
+        from ray_tpu.workflow import workflow_access
+
+        workflow_access.notify(
+            "register", self.workflow_id, self.storage, os.getpid(),
+            os.uname().nodename,
+        )
         ids: Dict[int, str] = {}
         memo: Dict[int, Any] = {}
 
@@ -151,6 +180,29 @@ class _WorkflowRun:
                     for a in n._bound_args]
             kwargs = {k: resolve(v) if isinstance(v, DAGNode) else v
                       for k, v in n._bound_kwargs.items()}
+            # check AFTER dependencies resolved, right before the step
+            # launches: a cancel landing while upstream steps execute
+            # must stop the unwind (a descent-time check would run at
+            # t~0 for every node and catch nothing)
+            if self.read_meta().get("status") == CANCELED:
+                raise WorkflowCancellationError(self.workflow_id)
+            if isinstance(n, EventNode):
+                # event steps run in-process: the listener blocks until
+                # the event arrives, the payload checkpoints, and only
+                # then is event_checkpointed acked (at-least-once)
+                listener, value = n.poll(args, kwargs)
+                self.save_step(sid, value)
+                try:
+                    listener.event_checkpointed(value)
+                except Exception:
+                    logger.warning(
+                        "event_checkpointed failed for %s in workflow %s; "
+                        "the event is checkpointed and will NOT be "
+                        "re-acked on resume", n.name, self.workflow_id,
+                        exc_info=True,
+                    )
+                memo[id(n)] = value
+                return value
             if isinstance(n, FunctionNode):
                 value = ray_tpu.get(n._fn.remote(*args, **kwargs))
             elif isinstance(n, ClassNode):
@@ -171,10 +223,15 @@ class _WorkflowRun:
 
         try:
             result = resolve(node)
+        except WorkflowCancellationError:
+            workflow_access.notify("mark", self.workflow_id, CANCELED)
+            raise
         except Exception as e:
             self.write_meta(status=FAILED, error=f"{type(e).__name__}: {e}")
+            workflow_access.notify("mark", self.workflow_id, FAILED)
             raise
         self.write_meta(status=SUCCESSFUL)
+        workflow_access.notify("mark", self.workflow_id, SUCCESSFUL)
         self.save_step("__output__", result)
         return result
 
@@ -248,6 +305,34 @@ def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
     return out["value"]
 
 
+def cancel(workflow_id: str, storage: Optional[str] = None) -> None:
+    """Cancel a running workflow (ray parity: workflow.cancel): the
+    durable meta flips to CANCELED and the executing driver's step loop
+    raises WorkflowCancellationError before its next step. Works from a
+    different driver via the management actor; falls back to writing
+    storage directly."""
+    from ray_tpu.workflow import workflow_access
+
+    meta_path = os.path.join(_storage_root(storage), workflow_id,
+                             "meta.pkl")
+    if not os.path.exists(meta_path):
+        raise WorkflowNotFoundError(workflow_id)
+    actor = workflow_access.get_management_actor()
+    if actor is not None:
+        try:
+            import ray_tpu
+
+            if ray_tpu.get(actor.cancel.remote(workflow_id), timeout=30):
+                return
+        except Exception:
+            pass
+    run = _WorkflowRun(workflow_id, storage)
+    if run.read_meta().get("status") == RUNNING:
+        # never clobber a terminal SUCCESSFUL/FAILED record: a canceled
+        # finished workflow would re-execute on the next run() call
+        run.write_meta(status=CANCELED)
+
+
 def list_all(storage: Optional[str] = None):
     root = _storage_root(storage)
     out = []
@@ -262,3 +347,9 @@ def delete(workflow_id: str, storage: Optional[str] = None):
 
     shutil.rmtree(os.path.join(_storage_root(storage), workflow_id),
                   ignore_errors=True)
+
+
+from ray_tpu.workflow.workflow_access import (  # noqa: E402
+    WorkflowManagementActor,
+    get_management_actor,
+)

@@ -1,0 +1,89 @@
+"""Workflow event listeners + management actor (ray parity:
+python/ray/workflow/event_listener.py + workflow_access.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+def test_event_checkpoint_and_ack(ray_start_regular, tmp_path):
+    """An observed event is checkpointed: resume never re-polls, and
+    event_checkpointed fires exactly once, after durability."""
+    polls = tmp_path / "polls"
+    acks = tmp_path / "acks"
+
+    class FileListener(workflow.EventListener):
+        def __init__(self, payload):
+            self.payload = payload
+
+        def poll_for_event(self):
+            polls.write_text(str(int(polls.read_text() or 0) + 1)
+                             if polls.exists() else "1")
+            return self.payload
+
+        def event_checkpointed(self, event):
+            acks.write_text(str(int(acks.read_text() or 0) + 1)
+                            if acks.exists() else "1")
+
+    @ray_tpu.remote
+    def consume(ev):
+        return f"got:{ev}"
+
+    storage = str(tmp_path / "wf")
+    dag = consume.bind(workflow.wait_for_event(FileListener, "E1"))
+    out = workflow.run(dag, workflow_id="evwf", storage=storage)
+    assert out == "got:E1"
+    assert polls.read_text() == "1" and acks.read_text() == "1"
+
+    # resume: the event step replays from its checkpoint — no new poll
+    dag2 = consume.bind(workflow.wait_for_event(FileListener, "E1"))
+    out2 = workflow.resume("evwf", dag2, storage=storage)
+    assert out2 == "got:E1"
+    assert polls.read_text() == "1", "resume must not re-wait for events"
+
+
+def test_timer_listener(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def after(ts):
+        return time.time() >= ts
+
+    fire_at = time.time() + 1.0
+    dag = after.bind(workflow.wait_for_event(workflow.TimerListener,
+                                             fire_at))
+    assert workflow.run(dag, storage=str(tmp_path / "wf")) is True
+
+
+def test_cancel_via_management_actor(ray_start_regular, tmp_path):
+    """A long workflow canceled from 'outside' (the management actor)
+    stops before its next step; status and registry reflect CANCELED."""
+    storage = str(tmp_path / "wf")
+
+    @ray_tpu.remote
+    def slow_step(i):
+        time.sleep(1.5)
+        return i
+
+    @ray_tpu.remote
+    def combine(*xs):
+        return sum(xs)
+
+    # a chain of slow steps gives cancel a window between steps
+    n1 = slow_step.bind(1)
+    n2 = combine.bind(n1)
+    n3 = slow_step.bind(n2)
+    n4 = combine.bind(n3)
+    fut = workflow.run_async(n4, workflow_id="cancelme", storage=storage)
+    time.sleep(0.5)  # let it register + start step 1
+    workflow.cancel("cancelme", storage=storage)
+    with pytest.raises(workflow.WorkflowCancellationError):
+        fut.result(timeout=60)
+    assert workflow.get_status("cancelme", storage=storage) == "CANCELED"
+
+    runs = ray_tpu.get(
+        workflow.get_management_actor().list_runs.remote(), timeout=30
+    )
+    assert runs["cancelme"]["status"] == "CANCELED"
+    assert runs["cancelme"]["host"]
