@@ -128,6 +128,18 @@ def init(
         global_worker.mode = "driver"
         if log_to_driver:
             _subscribe_worker_logs(cw)
+        # local usage snapshot (reference: usage_lib's session report;
+        # this build never phones home — see usage_lib docstring)
+        if global_worker.node is not None:
+            try:
+                from ray_tpu._private import usage_lib
+
+                if usage_lib.usage_stats_enabled():
+                    usage_lib.write_usage_stats(
+                        global_worker.node.session_dir
+                    )
+            except Exception:
+                pass
         return RayContext(address, cw.node_id)
 
 
