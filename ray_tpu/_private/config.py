@@ -116,6 +116,8 @@ _flag("log_tail_interval_s", float, 0.3)
 # Push plane (ray: push_manager.h max_chunks_in_flight per push)
 _flag("push_max_chunks_in_flight", int, 8)
 _flag("push_rx_expiry_s", float, 60.0)  # abandoned inbound push sessions
+# Idle workers spawned at raylet boot (ray: prestart_worker_first_driver)
+_flag("worker_prestart", int, 2)
 # Direct task push over worker leases (ray: direct_task_transport.cc)
 _flag("direct_task_leases", bool, True)
 _flag("direct_lease_pipeline_depth", int, 4)  # in-flight tasks per lease

@@ -76,7 +76,11 @@ def _wait_port_file(path: str, timeout: float = 30.0) -> list:
 
 def package_env(env: Optional[dict] = None) -> dict:
     """Env with PYTHONPATH including ray_tpu's parent dir, so subprocesses can
-    import the package regardless of the caller's cwd/installation."""
+    import the package regardless of the caller's cwd/installation.
+
+    Restores a TPU-plugin env var stashed by ``control_plane_env`` so
+    WORKERS (spawned by the raylet with this env) keep the accelerator
+    path even though their raylet runs without it."""
     env = dict(env if env is not None else os.environ)
     import ray_tpu
 
@@ -84,14 +88,34 @@ def package_env(env: Optional[dict] = None) -> dict:
     existing = env.get("PYTHONPATH", "")
     if pkg_root not in existing.split(os.pathsep):
         env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+    stash = env.pop("RAY_TPU_TPU_PLUGIN_STASH", None)
+    if (stash and "PALLAS_AXON_POOL_IPS" not in env
+            and env.get("JAX_PLATFORMS") != "cpu"):
+        # CPU-pinned processes (the test suite, CPU-only workers) skip
+        # the TPU plugin re-registration — and with it sitecustomize's
+        # multi-second jax import at interpreter boot
+        env["PALLAS_AXON_POOL_IPS"] = stash
     return env
 
 
-def _spawn(cmd, log_path: str, env=None) -> subprocess.Popen:
+def control_plane_env(env: Optional[dict] = None) -> dict:
+    """Spawn env for GCS/raylet processes: these never touch jax, but the
+    environment's sitecustomize imports it (~2s of interpreter boot per
+    process) whenever PALLAS_AXON_POOL_IPS is set. Strip the trigger —
+    stashed so package_env restores it for worker spawns — and the
+    control plane boots in a fraction of the time."""
+    env = package_env(env)
+    pool_ips = env.pop("PALLAS_AXON_POOL_IPS", None)
+    if pool_ips:
+        env["RAY_TPU_TPU_PLUGIN_STASH"] = pool_ips
+    return env
+
+
+def _spawn(cmd, log_path: str, env: dict) -> subprocess.Popen:
+    """Callers build the env explicitly (control_plane_env for
+    GCS/raylet/agents, package_env for anything that may use jax)."""
     out = open(log_path, "ab")
-    return subprocess.Popen(
-        cmd, stdout=out, stderr=subprocess.STDOUT, env=package_env(env)
-    )
+    return subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env)
 
 
 class NodeProcesses:
@@ -145,7 +169,7 @@ class NodeProcesses:
                  "--persist-path", self.gcs_persist_path,
                  "--cluster-id", os.path.basename(self.session_dir)],
                 os.path.join(self.logs, "gcs.out"),
-                env=dict(os.environ),
+                env=control_plane_env(),
             )
             self.gcs_port = int(_wait_port_file(port_file)[0])
         else:
@@ -163,7 +187,8 @@ class NodeProcesses:
         if labels is not None:
             cmd += ["--labels", json.dumps(labels)]
         self.raylet_proc = _spawn(
-            cmd, os.path.join(self.logs, f"raylet_{suffix}.out"), env=dict(os.environ)
+            cmd, os.path.join(self.logs, f"raylet_{suffix}.out"),
+            env=control_plane_env(),
         )
         lines = _wait_port_file(raylet_port_file)
         self.raylet_port = int(lines[0])
@@ -199,7 +224,7 @@ class NodeProcesses:
              "--persist-path", self.gcs_persist_path,
              "--cluster-id", os.path.basename(self.session_dir)],
             os.path.join(self.logs, "gcs.out"),
-            env=dict(os.environ),
+            env=control_plane_env(),
         )
 
     def shutdown(self):

@@ -266,6 +266,12 @@ class Raylet:
         # chunk pipelines + receiver-side assembly buffers
         self._pushes_inflight: Dict[tuple, asyncio.Future] = {}
         self._push_peer_sems: Dict[str, asyncio.Semaphore] = {}
+        # in-flight worker spawns per env hash + wakeup for waiters
+        # (requests wait on a booting same-env worker instead of racing
+        # another spawn against it)
+        self._workers_starting: Dict[str, int] = {}
+        self._spawn_waiters: Dict[str, int] = {}
+        self._worker_started = asyncio.Event()
         self._push_rx: Dict[bytes, dict] = {}
         self._pull_gate = _PullGate(
             cfg.max_concurrent_pulls,
@@ -320,15 +326,37 @@ class Raylet:
         )
         if cfg.enable_node_agent:
             asyncio.get_running_loop().create_task(self._start_agent())
+        if cfg.worker_prestart > 0:
+            asyncio.get_running_loop().create_task(self._prestart_workers())
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
+
+    async def _prestart_workers(self):
+        """Warm the idle pool at boot (ray: worker_pool.cc PrestartWorkers
+        / prestart_worker_first_driver): a worker process costs several
+        seconds of interpreter+import time, and paying it during startup
+        overlaps with driver setup instead of the first task's latency."""
+        n = min(int(cfg.worker_prestart),
+                max(1, int(self.resources_total.get("CPU", 1))))
+        for _ in range(n):
+            if len(self.all_workers) >= cfg.num_workers_soft_limit:
+                return
+            try:
+                w = await self._start_worker(None, None)
+                if w is not None and w.lease_id is None \
+                        and w.busy_with is None:
+                    self._return_worker(w)
+            except Exception:
+                logger.debug("worker prestart failed", exc_info=True)
+                return
 
     async def _start_agent(self):
         """Spawn this node's dashboard agent (ray: agent_manager.h — a
         per-node agent process serving node-local HTTP: stats, logs,
         stacks). Its port registers in the GCS KV so the head/operators
         can find it; failure is non-fatal (agents are observability)."""
-        from ray_tpu._private.node import package_env
+
+        from ray_tpu._private.node import control_plane_env
 
         port_file = os.path.join(
             self.session_dir, f"agent_port_{self.node_id[:8]}"
@@ -339,7 +367,9 @@ class Raylet:
                  "--raylet-port", str(self.port),
                  "--session-dir", self.session_dir,
                  "--port-file", port_file],
-                env=package_env(),
+                # control-plane process: must not re-gain the TPU-plugin
+                # trigger (and its jax import) from the stash
+                env=control_plane_env(),
                 stdout=open(os.path.join(
                     self.session_dir, "logs", f"agent_{self.node_id[:8]}.out"
                 ), "ab"),
@@ -1365,11 +1395,29 @@ class Raylet:
     async def _pop_worker_for(self, job_id: Optional[bytes],
                               runtime_env: Optional[dict]) -> Optional[_Worker]:
         env_hash = runtime_env_hash(runtime_env)
-        pool = self.idle_workers.get(env_hash)
-        while pool:
-            w = pool.popleft()
-            if w.conn is not None and not w.conn.closed:
-                return w
+        while True:
+            pool = self.idle_workers.get(env_hash)
+            while pool:
+                w = pool.popleft()
+                if w.conn is not None and not w.conn.closed:
+                    return w
+            # A same-env worker is mid-boot (prestart or a concurrent
+            # request): wait for it instead of racing a duplicate multi-
+            # second interpreter spawn — but only as many waiters as
+            # there are boots in flight, so N genuinely-concurrent
+            # requests still spawn N workers in parallel.
+            starting = self._workers_starting.get(env_hash, 0)
+            waiting = self._spawn_waiters.get(env_hash, 0)
+            if starting <= waiting:
+                break
+            self._spawn_waiters[env_hash] = waiting + 1
+            try:
+                await asyncio.wait_for(self._worker_started.wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self._spawn_waiters[env_hash] -= 1
+            self._worker_started.clear()
         n_alive = len(self.all_workers)
         if n_alive >= cfg.num_workers_soft_limit:
             # Reclaim ONE idle worker of a different runtime env to free a slot.
@@ -1395,6 +1443,11 @@ class Raylet:
         if runtime_env:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = str(v)
+            if env.get("JAX_PLATFORMS") == "cpu":
+                # the runtime_env pinned this worker to CPU after
+                # package_env's stash restore ran: drop the TPU-plugin
+                # trigger so the worker skips sitecustomize's jax import
+                env.pop("PALLAS_AXON_POOL_IPS", None)
         env["RAY_TPU_NODE_ID"] = self.node_id
         # workers bind their direct-push server to the same host the
         # raylet advertises in lease grants and actor direct_addrs
@@ -1450,6 +1503,9 @@ class Raylet:
         w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env),
                     log_path=log_file)
         self.all_workers[proc.pid] = w
+        ehash = w.env_hash
+        self._workers_starting[ehash] = \
+            self._workers_starting.get(ehash, 0) + 1
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
         except asyncio.TimeoutError:
@@ -1457,6 +1513,9 @@ class Raylet:
             proc.kill()
             self.all_workers.pop(proc.pid, None)
             return None
+        finally:
+            self._workers_starting[ehash] -= 1
+            self._worker_started.set()
         return w
 
     # ------------------------------------------------------------------
