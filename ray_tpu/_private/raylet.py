@@ -2160,6 +2160,15 @@ class Raylet:
             pass
         return {}
 
+    async def rpc_free_objects(self, conn: Connection, p):
+        """Tick-batched frees from an owner (one frame per release burst)."""
+        for oid in p["object_ids"]:
+            try:
+                await self.gcs.request("free_object", {"object_id": oid})
+            except Exception:
+                pass
+        return {}
+
     # ------------------------------------------------------------------
     # profiling (ray: dashboard reporter's py-spy stack dumps — here the
     # workers self-report via sys._current_frames)

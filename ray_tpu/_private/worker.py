@@ -192,6 +192,9 @@ class CoreWorker:
         # worker-side task-event buffer for direct-push executions
         self._tev_buf: List[dict] = []
         self._tev_flushing = False
+        # tick-batched object frees (see _maybe_free)
+        self._free_buf: List[bytes] = []
+        self._free_flushing = False
         threading.Thread(
             target=self._release_drain_loop,
             name=f"ref-release-{self.client_id[:6]}", daemon=True,
@@ -1993,8 +1996,23 @@ class CoreWorker:
                 pass
         for token in contains or ():
             self.unpin_object(token)
+        # tick-batched frees: ref churn (a put-per-iteration loop) would
+        # otherwise fire one RPC + io-loop wakeup per dropped object
+        self._free_buf.append(oid)
+        if not self._free_flushing:
+            self._free_flushing = True
+            try:
+                self.io.call_soon(self._flush_frees())
+            except Exception:
+                self._free_flushing = False
+
+    async def _flush_frees(self):
+        buf, self._free_buf = self._free_buf, []
+        self._free_flushing = False
+        if not buf:
+            return
         try:
-            self.io.call_soon(self.raylet.request("free_object", {"object_id": oid}))
+            await self.raylet.notify("free_objects", {"object_ids": buf})
         except Exception:
             pass
 
