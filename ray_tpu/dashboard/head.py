@@ -33,6 +33,17 @@ def _build_app():
 
     routes = web.RouteTableDef()
 
+    @routes.get("/")
+    async def index(request):
+        """Single-file UI over this JSON API (stands in for the
+        reference's React client without a build toolchain)."""
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "static",
+                            "index.html")
+        with open(path) as f:
+            return web.Response(text=f.read(), content_type="text/html")
+
     @routes.get("/api/v0/healthz")
     async def healthz(request):
         return _json_response({"status": "ok"})

@@ -86,3 +86,19 @@ def test_cli_list_and_summary(ray_start_regular):
     )
     assert out.returncode == 0, out.stderr
     assert "ping" in out.stdout
+
+
+def test_dashboard_ui_served(ray_start_regular):
+    """The single-file UI renders at / and references the JSON API."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    port = start_dashboard()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10
+        ) as resp:
+            body = resp.read().decode()
+            assert "text/html" in resp.headers["Content-Type"]
+        assert "/api/v0/nodes" in body and "ray_tpu" in body
+    finally:
+        stop_dashboard()
