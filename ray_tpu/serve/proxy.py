@@ -98,6 +98,26 @@ class HTTPProxy:
                             f"{type(e).__name__}: {e}",
                         )
 
+                def streaming(request_bytes, context):
+                    meta = dict(context.invocation_metadata() or ())
+                    try:
+                        yield from outer._grpc_stream(
+                            meta, request_bytes
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            f"{type(e).__name__}: {e}",
+                        )
+
+                if hcd.method.endswith("/Stream"):
+                    # server streaming: one pickled message per yielded
+                    # chunk of a generator deployment
+                    return grpc.unary_stream_rpc_method_handler(
+                        streaming,
+                        request_deserializer=None,
+                        response_serializer=None,
+                    )
                 return grpc.unary_unary_rpc_method_handler(
                     unary,
                     request_deserializer=None,  # raw bytes in
@@ -115,7 +135,9 @@ class HTTPProxy:
         self._grpc_server = server
         self._grpc_actual_port = bound
 
-    def _grpc_call(self, method: str, meta: dict, request_bytes: bytes):
+    def _grpc_invoke(self, meta: dict, request_bytes: bytes):
+        """Shared routing + invocation for both gRPC shapes: returns the
+        RAW handler result (a stream-marker dict for generators)."""
         import pickle
 
         import ray_tpu
@@ -159,15 +181,55 @@ class HTTPProxy:
             args, kwargs = (payload,), {}
         call_method = meta.get("method")
         h = getattr(handle, call_method) if call_method else handle
-        result = ray_tpu.get(h.remote(*args, **kwargs).ref, timeout=60)
+        return ray_tpu.get(h.remote(*args, **kwargs).ref, timeout=60)
+
+    def _grpc_call(self, method: str, meta: dict, request_bytes: bytes):
+        import pickle
+
         from ray_tpu.serve.replica import STREAM_MARKER
 
+        result = self._grpc_invoke(meta, request_bytes)
         if isinstance(result, dict) and STREAM_MARKER in result:
             # generator deployment: unary gRPC drains the whole stream
             # and returns the concatenated output (never the internal
             # stream marker)
             result = self._drain_stream(result[STREAM_MARKER])
         return pickle.dumps(result)
+
+    def _grpc_stream(self, meta: dict, request_bytes: bytes):
+        """Server-streaming: one pickled message per yielded chunk of a
+        generator deployment, emitted as the replica produces them (ray
+        parity: the gRPC proxy's streaming RPCs). Non-generator results
+        stream as a single message."""
+        import pickle
+
+        import ray_tpu
+
+        from ray_tpu.serve.replica import STREAM_MARKER
+
+        result = self._grpc_invoke(meta, request_bytes)
+        if not (isinstance(result, dict) and STREAM_MARKER in result):
+            yield pickle.dumps(result)
+            return
+        info = result[STREAM_MARKER]
+        replica = ray_tpu.get_actor(info["replica"])
+        sid = info["stream_id"]
+        try:
+            while True:
+                items, done = ray_tpu.get(
+                    replica.next_chunks.remote(sid), timeout=60
+                )
+                for item in items:
+                    yield pickle.dumps(item)
+                if done:
+                    return
+        except BaseException:
+            # client hung up / replica died: stop the producer
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+            raise
 
     def _drain_stream(self, info: dict):
         import ray_tpu

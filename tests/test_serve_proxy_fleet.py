@@ -93,3 +93,54 @@ def test_proxy_fleet_multi_node_and_grpc(ray_start_cluster):
         assert revived is not None, "killed proxy was not restarted"
     finally:
         serve.shutdown()
+
+
+def test_grpc_streaming(ray_start_cluster):
+    """Server-streaming gRPC: a generator deployment's chunks arrive as
+    individual messages (not one drained blob)."""
+    import pickle
+
+    import grpc
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    serve.start()
+    try:
+        @serve.deployment(num_replicas=1)
+        class Tokens:
+            def __call__(self, n):
+                for i in range(int(n)):
+                    yield f"tok{i}"
+
+        serve.run(Tokens.bind(), name="default", route_prefix="/")
+        ctrl = _controller()
+        info = next(iter(
+            ray_tpu.get(ctrl.get_proxies.remote(), timeout=30).values()
+        ))
+        channel = grpc.insecure_channel(f"127.0.0.1:{info['grpc_port']}")
+        stream = channel.unary_stream(
+            "/ray_tpu.serve.Ingress/Stream",
+            request_serializer=None, response_deserializer=None,
+        )
+        chunks = [pickle.loads(m) for m in stream(
+            pickle.dumps(((4,), {})),
+            metadata=(("application", "default"),), timeout=60,
+        )]
+        assert chunks == ["tok0", "tok1", "tok2", "tok3"]
+
+        # non-generator target: single message
+        @serve.deployment(num_replicas=1)
+        class One:
+            def __call__(self, x):
+                return {"v": x}
+
+        serve.run(One.bind(), name="one", route_prefix="/one")
+        chunks = [pickle.loads(m) for m in stream(
+            pickle.dumps((("a",), {})),
+            metadata=(("application", "one"),), timeout=60,
+        )]
+        assert chunks == [{"v": "a"}]
+        channel.close()
+    finally:
+        serve.shutdown()
