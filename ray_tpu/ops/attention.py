@@ -25,6 +25,9 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+# lse/delta side tensors are stored lane-broadcast (last dim = one 128-lane
+# register row) so their Pallas blocks satisfy the TPU (8, 128) tiling rule
+_LANES = 128
 
 
 def attention_reference(q, k, v, *, causal: bool = False,
@@ -177,12 +180,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
         m = m_scr[...]
-        # rows with no live columns get lse=+inf => p == 0 in the backward
+        # rows with no live columns get lse=+inf => p == 0 in the backward.
+        # lse is stored lane-broadcast as (block_q, LANES): a (block_q,)
+        # vector output would need a (1, block_q) block, which violates the
+        # TPU (8, 128) tiling rule once the batch dim is squeezed.
         lse = jnp.where(
-            l[:, 0] == 0.0, jnp.inf,
-            jnp.where(m[:, 0] > NEG_INF / 2, m[:, 0], 0.0) + jnp.log(l_safe[:, 0]),
+            l == 0.0, jnp.inf,
+            jnp.where(m > NEG_INF / 2, m, 0.0) + jnp.log(l_safe),
         )
-        lse_ref[...] = lse
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -205,8 +211,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...][:, None]
-        delta = delta_ref[...][:, None]
+        lse = lse_ref[...][:, 0:1]
+        delta = delta_ref[...][:, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -246,8 +252,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...][:, None]
-        delta = delta_ref[...][:, None]
+        lse = lse_ref[...][:, 0:1]
+        delta = delta_ref[...][:, 0:1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -310,11 +316,12 @@ def _flash_pallas(q, k, v, *, causal: bool, sm_scale: float,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bi, qi, ki: (bi, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda bi, qi, ki: (bi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, q_len, d), q.dtype),
-            jax.ShapeDtypeStruct((b, q_len), jnp.float32),
+            jax.ShapeDtypeStruct((b, q_len, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -348,8 +355,10 @@ def _flash_pallas_bwd_kernels(q, k, v, do, lse, delta, *, causal: bool,
             kspec(lambda bi, qi, ki: (bi, ki, 0)),
             kspec(lambda bi, qi, ki: (bi, ki, 0)),
             qspec(lambda bi, qi, ki: (bi, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
-            pl.BlockSpec((None, block_q), lambda bi, qi, ki: (bi, qi)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda bi, qi, ki: (bi, qi, 0)),
         ],
         out_specs=qspec(lambda bi, qi, ki: (bi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, q_len, d), q.dtype),
@@ -369,8 +378,10 @@ def _flash_pallas_bwd_kernels(q, k, v, do, lse, delta, *, causal: bool,
             kspec(lambda bi, ki, qi: (bi, ki, 0)),
             kspec(lambda bi, ki, qi: (bi, ki, 0)),
             qspec(lambda bi, ki, qi: (bi, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bi, ki, qi: (bi, qi)),
-            pl.BlockSpec((None, block_q), lambda bi, ki, qi: (bi, qi)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda bi, ki, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda bi, ki, qi: (bi, qi, 0)),
         ],
         out_specs=[
             kspec(lambda bi, ki, qi: (bi, ki, 0)),
@@ -412,8 +423,10 @@ def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret,
                       res, g):
     q, k, v, out, lse = res
-    # delta_i = rowsum(dO_i * O_i); tiny elementwise reduce — XLA fuses it
+    # delta_i = rowsum(dO_i * O_i); tiny elementwise reduce — XLA fuses it.
+    # Lane-broadcast to (b, q_len, _LANES) to match the lse layout.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
     dq, dk, dv = _flash_pallas_bwd_kernels(
         q, k, v, g, lse, delta, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,

@@ -89,9 +89,7 @@ class HTTPProxy:
                 def unary(request_bytes, context):
                     meta = dict(context.invocation_metadata() or ())
                     try:
-                        return outer._grpc_call(
-                            hcd.method, meta, request_bytes
-                        )
+                        return outer._grpc_call(meta, request_bytes)
                     except Exception as e:  # noqa: BLE001
                         context.abort(
                             grpc.StatusCode.INTERNAL,
@@ -183,7 +181,7 @@ class HTTPProxy:
         h = getattr(handle, call_method) if call_method else handle
         return ray_tpu.get(h.remote(*args, **kwargs).ref, timeout=60)
 
-    def _grpc_call(self, method: str, meta: dict, request_bytes: bytes):
+    def _grpc_call(self, meta: dict, request_bytes: bytes):
         import pickle
 
         from ray_tpu.serve.replica import STREAM_MARKER
