@@ -21,17 +21,19 @@ from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.env import env_spaces, make_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import (
+    A2CLearner,
     APPOLearner,
     DQNLearner,
     ImpalaLearner,
     Learner,
+    PGLearner,
     PPOLearner,
     SACLearner,
     TD3Learner,
 )
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import RLModule
-from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae, returns_to_go
 from ray_tpu.tune.trainable import Trainable
 
 
@@ -542,6 +544,48 @@ class PPO(Algorithm):
         return self.learner.update(batch)
 
 
+class PG(Algorithm):
+    """Vanilla policy gradient (ray parity: rllib/algorithms/pg):
+    Monte-Carlo returns-to-go, no critic in the loss."""
+
+    _learner_cls = PGLearner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        processed = []
+        for frag in self._sample_all():
+            frag[sb.ADVANTAGES] = returns_to_go(frag, cfg.gamma)
+            processed.append(frag)
+        batch = SampleBatch.concat(processed)
+        # normalize across the whole train batch (variance reduction —
+        # REINFORCE has no baseline)
+        ret = batch[sb.ADVANTAGES]
+        batch[sb.ADVANTAGES] = (ret - ret.mean()) / (ret.std() + 1e-8)
+        self._timesteps += batch.count
+        return self.learner.update(batch)
+
+
+class A2C(Algorithm):
+    """Synchronous advantage actor-critic (ray parity:
+    rllib/algorithms/a2c): PPO's sampling + GAE plumbing, unclipped loss,
+    exactly one gradient pass per batch."""
+
+    _learner_cls = A2CLearner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        processed = [
+            compute_gae(frag, float(frag["bootstrap_value"][-1]),
+                        cfg.gamma, cfg.lambda_)
+            for frag in self._sample_all()
+        ]
+        batch = SampleBatch.concat(processed)
+        self._timesteps += batch.count
+        return self.learner.update(batch)
+
+
 class IMPALA(Algorithm):
     _learner_cls = ImpalaLearner
 
@@ -730,6 +774,20 @@ class DDPG(TD3):
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(PPO)
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PG)
+        self.lr = 1e-2
+        self.num_epochs = 1
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(A2C)
+        self.lr = 1e-2
+        self.entropy_coeff = 0.01
 
 
 class APPOConfig(AlgorithmConfig):

@@ -95,16 +95,27 @@ class _RunnerBase:
     def evaluate(self, num_episodes: int = 5) -> float:
         """Greedy policy evaluation, returns mean episode return."""
         total = []
+        self._eval_steps = 0
         for _ in range(num_episodes):
             obs, _ = self.env.reset()
             ep_ret, done = 0.0, False
             while not done:
                 obs, r, term, trunc, _ = self.env.step(self._eval_action(obs))
                 ep_ret += r
+                self._eval_steps += 1
                 done = term or trunc
             total.append(ep_ret)
         self._reset_sampling_state()
         return float(np.mean(total))
+
+    def evaluate_with(self, params, num_episodes: int = 1) -> Dict[str, float]:
+        """Atomic set_weights + evaluate (for ES/ARS candidate scoring):
+        a retried call after an actor restart re-runs BOTH halves, so a
+        respawned runner can never score with its re-initialized seed
+        weights. Returns the mean return and the env steps consumed."""
+        self.set_weights(params)
+        score = self.evaluate(num_episodes)
+        return {"return": score, "steps": float(self._eval_steps)}
 
 
 class EnvRunner(_RunnerBase):

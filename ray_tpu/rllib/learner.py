@@ -204,6 +204,70 @@ class PPOLearner(Learner):
         return self.sgd_epochs(batch, step_fn=ddp_step)
 
 
+class PGLearner(Learner):
+    """Vanilla policy gradient / REINFORCE (ray parity:
+    rllib/algorithms/pg): loss = -E[logp(a|s) * R_t] with normalized
+    Monte-Carlo returns-to-go and no baseline; the module's value head
+    exists but is untrained."""
+
+    supports_ddp = True
+
+    def __init__(self, module, config):
+        super().__init__(module, config)
+        net = module.net
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logits, _ = net.apply({"params": params}, mb[sb.OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb[sb.ACTIONS][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            ret = mb[sb.ADVANTAGES]  # returns-to-go, normalized upstream
+            pi_loss = -(logp * ret).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss - ent_coeff * entropy
+            return total, (pi_loss, jnp.float32(0.0), entropy)
+
+        self._train_step = self._build_train_step(loss_fn)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self.sgd_epochs(batch)
+
+
+class A2CLearner(Learner):
+    """Advantage actor-critic (ray parity: rllib/algorithms/a2c): the
+    unclipped PPO objective — one synchronous pass per batch, GAE
+    advantages, trained value baseline."""
+
+    supports_ddp = True
+
+    def __init__(self, module, config):
+        super().__init__(module, config)
+        net = module.net
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logits, values = net.apply({"params": params}, mb[sb.OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb[sb.ACTIONS][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            adv = mb[sb.ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pi_loss = -(logp * adv).mean()
+            vf_loss = ((values - mb[sb.TARGETS]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, (pi_loss, vf_loss, entropy)
+
+        self._train_step = self._build_train_step(loss_fn)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self._update_full_batch(batch)
+
+
 def vtrace(behavior_logp, target_logp, rewards, values, next_values, dones,
            truncateds, gamma, clip_rho: float = 1.0, clip_c: float = 1.0):
     """V-trace targets (IMPALA) over one fragment (time-major 1D arrays).

@@ -17,7 +17,7 @@ import numpy as np
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.learner import Learner
-from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.sample_batch import SampleBatch, returns_to_go
 
 
 def write_json(batches: List[SampleBatch], path: str) -> str:
@@ -125,3 +125,190 @@ class BCConfig(AlgorithmConfig):
         if input_ is not None:
             self.offline_input = input_
         return self
+
+
+class MARWILLearner(Learner):
+    """Monotonic advantage re-weighted imitation learning (ray parity:
+    rllib/algorithms/marwil): exp(beta * advantage)-weighted action
+    cross-entropy plus a value-head regression to the recorded returns;
+    beta=0 reduces exactly to BC."""
+
+    def __init__(self, module, config):
+        import jax
+        import jax.numpy as jnp
+
+        super().__init__(module, config)
+        net = module.net
+        beta = config.beta
+        vf_coeff = config.vf_loss_coeff
+
+        def loss_fn(params, mb):
+            logits, values = net.apply({"params": params}, mb[sb.OBS])
+            logp = jax.nn.log_softmax(logits)
+            act = mb[sb.ACTIONS].astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, act[:, None], axis=1)[:, 0]
+            ret = mb["returns"]
+            adv = ret - values
+            # moving-average normalizer folded into the batch (reference
+            # keeps a running MA of |adv|; batch-local is the jit-pure form)
+            adv_n = adv / (jnp.abs(adv).mean() + 1e-8)
+            weight = jnp.exp(jnp.clip(beta * jax.lax.stop_gradient(adv_n),
+                                      -10.0, 10.0))
+            pi_loss = (weight * nll).mean()
+            vf_loss = (adv**2).mean()
+            total = pi_loss + vf_coeff * vf_loss
+            return total, (pi_loss, vf_loss)
+
+        def train_step(params, opt_state, mb):
+            import optax
+
+            (total, (pi, vf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, mb)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "policy_loss": pi, "vf_loss": vf,
+            }
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self.sgd_epochs(batch, keys=(sb.OBS, sb.ACTIONS, "returns"))
+
+
+class MARWIL(BC):
+    """Offline advantage-weighted imitation (ray parity:
+    rllib/algorithms/marwil). Same offline data plane as BC; the dataset
+    gains a ``returns`` column (discounted returns-to-go) for the
+    advantage weighting."""
+
+    _learner_cls = MARWILLearner
+
+    def setup(self, config):
+        super().setup(config)
+        if "returns" not in self._dataset:
+            if (sb.REWARDS not in self._dataset
+                    or sb.DONES not in self._dataset):
+                raise ValueError(
+                    "MARWIL needs 'returns' or rewards/dones columns in "
+                    "the offline data"
+                )
+            self._dataset["returns"] = returns_to_go(
+                self._dataset, self._algo_config.gamma
+            )
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0
+        self.vf_loss_coeff = 1.0
+        self.num_epochs = 5
+
+
+class CQLLearner(Learner):
+    """Discrete conservative Q-learning (ray parity: rllib/algorithms/cql,
+    discrete form): the DQN TD loss on logged transitions plus the CQL
+    regularizer  E[logsumexp_a Q(s,a) - Q(s, a_logged)], which pushes down
+    Q on actions the dataset never took (the offline over-estimation
+    fix)."""
+
+    def __init__(self, module, config):
+        import jax
+        import jax.numpy as jnp
+
+        super().__init__(module, config)
+        net = module.net
+        gamma = config.gamma
+        alpha = config.cql_alpha
+        self.target_params = jax.tree.map(jnp.copy, module.params)
+
+        def loss_fn(params, target_params, mb):
+            q, _ = net.apply({"params": params}, mb[sb.OBS])
+            act = mb[sb.ACTIONS].astype(jnp.int32)
+            q_sel = jnp.take_along_axis(q, act[:, None], axis=1)[:, 0]
+            q_next, _ = net.apply({"params": target_params},
+                                  mb[sb.NEXT_OBS])
+            target = mb[sb.REWARDS] + gamma * (
+                1.0 - mb[sb.DONES].astype(jnp.float32)
+            ) * q_next.max(axis=-1)
+            td = q_sel - jax.lax.stop_gradient(target)
+            td_loss = (td**2).mean()
+            cql_term = (jax.nn.logsumexp(q, axis=-1) - q_sel).mean()
+            return td_loss + alpha * cql_term, (td_loss, cql_term)
+
+        def train_step(params, target_params, opt_state, mb):
+            import optax
+
+            (total, (td, cql)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, mb)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "td_loss": td, "cql_loss": cql,
+            }
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()
+               if k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                        sb.NEXT_OBS)}
+        self.module.params, self.opt_state, metrics = self._train_step(
+            self.module.params, self.target_params, self.opt_state, jmb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self):
+        import jax
+        import jax.numpy as jnp
+
+        self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
+
+class CQL(BC):
+    """Offline discrete CQL: minibatch TD sweeps over the logged dataset
+    with periodic target sync; no environment sampling."""
+
+    _learner_cls = CQLLearner
+
+    def setup(self, config):
+        super().setup(config)
+        for key in (sb.NEXT_OBS, sb.REWARDS, sb.DONES):
+            if key not in self._dataset:
+                raise ValueError(f"CQL offline data needs {key!r}")
+        self._rng = np.random.default_rng(self._algo_config.seed)
+        self._since_target_sync = 0
+
+    def training_step(self) -> Dict:
+        cfg = self._algo_config
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            idx = self._rng.integers(0, self._dataset.count,
+                                     size=cfg.minibatch_size)
+            mb = SampleBatch({k: np.asarray(v)[idx]
+                              for k, v in self._dataset.items()})
+            metrics = self.learner.update(mb)
+            self._since_target_sync += 1
+            if self._since_target_sync >= cfg.target_sync_every:
+                self.learner.sync_target()
+                self._since_target_sync = 0
+        self._timesteps += cfg.num_epochs * cfg.minibatch_size
+        self._sync_weights()
+        return metrics
+
+
+class CQLConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        self.cql_alpha = 1.0
+        self.num_epochs = 50
+        self.minibatch_size = 256
+        self.target_sync_every = 20
+        self.lr = 1e-3

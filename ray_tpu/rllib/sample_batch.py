@@ -67,6 +67,24 @@ class SampleBatch(dict):
         ]
 
 
+def returns_to_go(batch: SampleBatch, gamma: float) -> np.ndarray:
+    """Discounted returns-to-go, reset at episode boundaries (terminated
+    OR truncated — past a cut, the tail of that episode is unknown to
+    this batch). Shared by PG (Monte-Carlo targets) and offline MARWIL."""
+    rewards = np.asarray(batch[REWARDS], np.float32)
+    dones = np.asarray(batch[DONES], bool)
+    truncs = np.asarray(batch.get(TRUNCATEDS, np.zeros(len(rewards), bool)),
+                        bool)
+    ret = np.zeros(len(rewards), np.float32)
+    running = 0.0
+    for t in reversed(range(len(rewards))):
+        if dones[t] or truncs[t]:
+            running = 0.0
+        running = rewards[t] + gamma * running
+        ret[t] = running
+    return ret
+
+
 def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
                 lam: float) -> SampleBatch:
     """Generalized advantage estimation over one rollout fragment
