@@ -117,6 +117,22 @@ class _RunnerBase:
         score = self.evaluate(num_episodes)
         return {"return": score, "steps": float(self._eval_steps)}
 
+    def evaluate_perturbed(self, base_flat, noise_seed: int, sign: float,
+                           noise_std: float,
+                           num_episodes: int = 1) -> Dict[str, float]:
+        """ES/ARS candidate scoring with seed-based weight reconstruction:
+        only the (shared base vector ref, seed, sign) cross the wire — the
+        perturbation is regenerated here from the seed, so per-candidate
+        payload is a few bytes instead of a full parameter pytree. Atomic
+        like evaluate_with (retry-safe after actor restarts)."""
+        from jax.flatten_util import ravel_pytree
+
+        _, unravel = ravel_pytree(self.module.params)
+        eps = np.random.default_rng(noise_seed).standard_normal(
+            base_flat.size).astype(np.float32)
+        theta = base_flat + sign * noise_std * eps
+        return self.evaluate_with(unravel(theta), num_episodes)
+
 
 class EnvRunner(_RunnerBase):
     def __init__(self, env_spec: Any, env_config: Optional[dict],

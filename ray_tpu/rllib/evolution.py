@@ -38,19 +38,24 @@ class ES(Algorithm):
         self._theta = np.asarray(flat, np.float32)
         self._es_rng = np.random.default_rng(self._algo_config.seed)
 
-    def _evaluate_population(self, candidates) -> np.ndarray:
-        """Fan candidate weight vectors across the runner fleet. Each
-        candidate is one ``evaluate_with`` call (atomic weights+rollout,
-        so actor restarts/retries re-run both halves), dispatched through
-        the shared runner-FT wrapper like every other algorithm's gang."""
+    def _evaluate_population(self, seeds, signs) -> np.ndarray:
+        """Fan (seed, sign) candidate descriptors across the runner fleet.
+        The base theta ships ONCE per iteration as a shared object-store
+        ref; each candidate call carries only a seed + sign, and the
+        runner regenerates the perturbation (evaluate_perturbed — atomic
+        weights+rollout, so actor restarts/retries re-run both halves).
+        Dispatched through the shared runner-FT wrapper like every other
+        algorithm's gang."""
         cfg = self._algo_config
 
         def fan_out():
+            base_ref = ray_tpu.put(self._theta)
             refs = [
-                self.runners[i % len(self.runners)].evaluate_with.remote(
-                    self._unravel(theta), cfg.episodes_per_candidate
+                self.runners[i % len(self.runners)].evaluate_perturbed.remote(
+                    base_ref, int(seed), float(sign), cfg.noise_std,
+                    cfg.episodes_per_candidate,
                 )
-                for i, theta in enumerate(candidates)
+                for i, (seed, sign) in enumerate(zip(seeds, signs))
             ]
             return ray_tpu.get(refs, timeout=600)
 
@@ -61,13 +66,15 @@ class ES(Algorithm):
     def training_step(self) -> Dict:
         cfg = self._algo_config
         half = cfg.population // 2
-        eps = self._es_rng.standard_normal(
-            (half, self._theta.size)).astype(np.float32)
-        candidates = np.concatenate([
-            self._theta[None] + cfg.noise_std * eps,
-            self._theta[None] - cfg.noise_std * eps,
+        seeds = self._es_rng.integers(0, 2**31 - 1, size=half)
+        eps = np.stack([
+            np.random.default_rng(int(s)).standard_normal(
+                self._theta.size).astype(np.float32)
+            for s in seeds
         ])
-        scores = self._evaluate_population(candidates)
+        all_seeds = np.concatenate([seeds, seeds])
+        signs = np.concatenate([np.ones(half), -np.ones(half)])
+        scores = self._evaluate_population(all_seeds, signs)
         update = self._es_update(eps, scores[:half], scores[half:])
         self._theta = self._theta + update
         self.module.set_state(self._unravel(self._theta))

@@ -421,6 +421,23 @@ class DQNLearner(Learner):
     def sync_target(self):
         self.target_params = jax.tree.map(jnp.copy, self.module.params)
 
+    # target net rides the optimizer-state channel so checkpoints restore
+    # it (same pattern as TD3/SAC; a fresh-init target after restore would
+    # feed garbage TD targets until the next sync)
+    def get_optimizer_state(self):
+        return {"opt": self.opt_state, "target_params": self.target_params}
+
+    def set_optimizer_state(self, state):
+        if state is None:
+            self.opt_state = self.tx.init(self.module.params)
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+        elif isinstance(state, dict) and "target_params" in state:
+            self.opt_state = state["opt"]
+            self.target_params = state["target_params"]
+        else:  # legacy checkpoint: raw optax state, no recorded target
+            self.opt_state = state
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
 
 class TD3Learner(Learner):
     """TD3 (Fujimoto et al. 2018) — and, with ``twin_q=False,

@@ -31,8 +31,10 @@ def write_json(batches: List[SampleBatch], path: str) -> str:
     return path
 
 
-def read_json(path: str) -> SampleBatch:
-    """Load recorded batches back (ray parity: JsonReader)."""
+def read_json_fragments(path: str) -> List[SampleBatch]:
+    """Load recorded batches preserving fragment boundaries (one recorded
+    SampleBatch per JSON line) — consumers that chain values through time
+    (returns-to-go) must not cross these seams."""
     batches = []
     with open(path) as f:
         for line in f:
@@ -44,7 +46,12 @@ def read_json(path: str) -> SampleBatch:
             }))
     if not batches:
         raise ValueError(f"no batches in {path}")
-    return SampleBatch.concat(batches)
+    return batches
+
+
+def read_json(path: str) -> SampleBatch:
+    """Load recorded batches back (ray parity: JsonReader)."""
+    return SampleBatch.concat(read_json_fragments(path))
 
 
 class BCLearner(Learner):
@@ -93,8 +100,11 @@ class BC(Algorithm):
         if input_ is None:
             raise ValueError("BCConfig.offline_data(input_=...) is required")
         if isinstance(input_, str):
-            self._dataset = read_json(input_)
+            self._fragments = read_json_fragments(input_)
+            self._dataset = SampleBatch.concat(self._fragments)
         elif isinstance(input_, SampleBatch):
+            # a single pre-built batch is one fragment by construction
+            self._fragments = [input_]
             self._dataset = input_
         else:  # ray_tpu.data Dataset of obs/actions columns
             rows = input_.take_all()
@@ -102,6 +112,7 @@ class BC(Algorithm):
                 sb.OBS: np.asarray([r["obs"] for r in rows], np.float32),
                 sb.ACTIONS: np.asarray([r["actions"] for r in rows], np.int32),
             })
+            self._fragments = [self._dataset]
 
     def training_step(self) -> Dict:
         metrics = self.learner.update(self._dataset)
@@ -194,9 +205,13 @@ class MARWIL(BC):
                     "MARWIL needs 'returns' or rewards/dones columns in "
                     "the offline data"
                 )
-            self._dataset["returns"] = returns_to_go(
-                self._dataset, self._algo_config.gamma
-            )
+            # per-fragment: the discount chain must not run across the
+            # seam between independently recorded fragments (the step
+            # before a seam is usually mid-episode, not terminal)
+            self._dataset["returns"] = np.concatenate([
+                returns_to_go(f, self._algo_config.gamma)
+                for f in self._fragments
+            ])
 
 
 class MARWILConfig(BCConfig):
@@ -269,6 +284,25 @@ class CQLLearner(Learner):
         import jax.numpy as jnp
 
         self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
+    # same checkpoint contract as DQNLearner: the target net restores with
+    # the optimizer state instead of silently reverting to fresh init
+    def get_optimizer_state(self):
+        return {"opt": self.opt_state, "target_params": self.target_params}
+
+    def set_optimizer_state(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        if state is None:
+            self.opt_state = self.tx.init(self.module.params)
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+        elif isinstance(state, dict) and "target_params" in state:
+            self.opt_state = state["opt"]
+            self.target_params = state["target_params"]
+        else:
+            self.opt_state = state
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
 
 
 class CQL(BC):

@@ -440,6 +440,16 @@ class TuneController:
             self._issue_train(trial)
 
     # ------------------------------------------------------------------
+    def stop_trial(self, trial: Trial, result: Optional[Dict] = None):
+        """Scheduler-facing termination (ray parity:
+        TuneController.stop_trial): used by synchronous schedulers to stop
+        trials OTHER than the one whose result is being processed (e.g.
+        HyperBand eliminating a cohort's losers)."""
+        if trial.status in (Trial.TERMINATED, Trial.ERROR):
+            return
+        self._complete_trial(trial, result or trial.last_result or {})
+
+    # ------------------------------------------------------------------
     def exploit_trial(self, trial: Trial, donor: Trial, new_config: Dict):
         """PBT: adopt donor's checkpoint + mutated config, restart trial."""
         donor_handle = self._actors.get(donor.trial_id)
@@ -465,11 +475,17 @@ class TuneController:
         # PENDING trials first; a PAUSED trial only resumes into a slot no
         # pending trial wants, so PAUSE actually yields the actor (reference:
         # scheduler choose_trial_to_run prefers fresh trials over paused).
+        may_resume = getattr(self._scheduler, "may_resume", None)
         for status in (Trial.PENDING, Trial.PAUSED):
             for t in self.trials:
                 if slots <= 0:
                     return out
                 if t.status == status and t.trial_id not in self._actors:
+                    if (status == Trial.PAUSED and may_resume is not None
+                            and not may_resume(t)):
+                        # synchronous scheduler is holding this trial for
+                        # its cohort — the slot goes to someone else
+                        continue
                     out.append(t)
                     slots -= 1
         return out

@@ -1,0 +1,148 @@
+"""Cohort-synchronous HyperBand semantics (ray parity:
+python/ray/tune/tests/test_trial_scheduler.py HyperBand cases)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import *  # noqa: F401,F403
+from ray_tpu.tune.schedulers import HyperBandScheduler
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class _FakeTrial:
+    def __init__(self, tid):
+        self.trial_id = tid
+        self.last_result = {}
+        self.status = "RUNNING"
+
+
+class _FakeController:
+    def __init__(self, trials):
+        self._trials = {t.trial_id: t for t in trials}
+        self.stopped = []
+
+    def get_trial(self, tid):
+        return self._trials.get(tid)
+
+    def stop_trial(self, trial, result=None):
+        self.stopped.append(trial.trial_id)
+
+
+def _result(it, score):
+    return {"training_iteration": it, "score": score}
+
+
+def test_bracket_geometry():
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9.0,
+                            reduction_factor=3.0)
+    trials = [_FakeTrial(f"t{i}") for i in range(12)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        hb.on_trial_add(ctl, t)
+    # s_max=2: bracket 0 (s=2) n=9 r0=1; bracket 1 (s=1) n=5 r0=3 (ceil(1.5*3))
+    b0, b1 = hb._brackets[0], hb._brackets[1]
+    assert b0.capacity == 9 and b0.milestones == [1.0, 3.0, 9.0]
+    assert b1.capacity == 5 and b1.milestones == [3.0, 9.0]
+    assert len(hb._brackets) == 2  # 12 trials: 9 + 3 of 5
+
+
+def test_synchronous_promotion_waits_for_cohort():
+    """No trial advances past a rung until EVERY live member reported —
+    the defining difference from ASHA."""
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9.0,
+                            reduction_factor=3.0)
+    trials = [_FakeTrial(f"t{i}") for i in range(9)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        hb.on_trial_add(ctl, t)
+
+    # first 8 report at the rung-0 milestone: all must PAUSE (cohort open)
+    for i in range(8):
+        d = hb.on_trial_result(ctl, trials[i], _result(1, score=i))
+        assert d == TrialScheduler.PAUSE, (i, d)
+        assert not hb.may_resume(trials[i])
+    assert ctl.stopped == []
+
+    # the 9th (best) report completes the cohort: top ceil(9/3)=3 promoted
+    d = hb.on_trial_result(ctl, trials[8], _result(1, score=100))
+    assert d == TrialScheduler.CONTINUE  # last reporter won: stays hot
+    # losers t0..t5 stopped; winners t6, t7 now resumable
+    assert sorted(ctl.stopped) == [f"t{i}" for i in range(6)]
+    assert hb.may_resume(trials[6]) and hb.may_resume(trials[7])
+
+
+def test_dead_member_does_not_block_cohort():
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9.0,
+                            reduction_factor=3.0)
+    trials = [_FakeTrial(f"t{i}") for i in range(9)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        hb.on_trial_add(ctl, t)
+    for i in range(8):
+        hb.on_trial_result(ctl, trials[i], _result(1, score=i))
+    # the 9th member dies before reporting: the cohort must settle anyway
+    hb.on_trial_error(ctl, trials[8])
+    assert sorted(ctl.stopped) == [f"t{i}" for i in range(5)]  # keep top 3 of 8
+    assert hb.may_resume(trials[5]) or not any(
+        hb.may_resume(trials[i]) for i in range(5)
+    )
+    assert hb.may_resume(trials[6]) and hb.may_resume(trials[7])
+
+
+def test_straggler_join_does_not_corrupt_settled_rung():
+    """A trial joining a non-full bracket after its rung-0 cohort settled
+    must be ranked on its own cohort — never demote or re-promote trials
+    already moved to higher rungs (regression: promote() once re-ranked
+    ALL recorded scores)."""
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9.0,
+                            reduction_factor=3.0)
+    a, b_ = _FakeTrial("a"), _FakeTrial("b")
+    ctl = _FakeController([a, b_])
+    hb.on_trial_add(ctl, a)
+    hb.on_trial_add(ctl, b_)
+    bracket = hb._brackets[0]
+    # both report rung 0: cohort of 2 settles, a (best) promoted, b stopped
+    hb.on_trial_result(ctl, b_, _result(1, score=1.0))
+    d = hb.on_trial_result(ctl, a, _result(1, score=5.0))
+    assert d == TrialScheduler.CONTINUE
+    assert bracket.rung_of["a"] == 1 and "b" not in bracket.live
+
+    # straggler c joins the same (non-full) bracket and reports rung 0
+    c = _FakeTrial("c")
+    ctl._trials["c"] = c
+    hb.on_trial_add(ctl, c)
+    assert hb._bracket_of["c"] is bracket
+    hb.on_trial_result(ctl, c, _result(1, score=99.0))
+    # a must still be at rung 1, not demoted, and never stopped
+    assert bracket.rung_of["a"] == 1
+    assert "a" not in ctl.stopped
+
+
+def test_hyperband_e2e_tuner(ray_start_regular):
+    """End-to-end through Tuner: separable objective, HyperBand finds a
+    near-optimal x while stopping most trials early."""
+    from ray_tpu import tune
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def objective(config):
+        for it in range(1, 10):
+            tune.report({"loss": (config["x"] - 0.7) ** 2 + 1.0 / it,
+                         "training_iteration": it})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-2, 2)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=14,
+            search_alg=None,
+            scheduler=HyperBandScheduler(
+                time_attr="training_iteration", max_t=9, reduction_factor=3
+            ),
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 1.6, best.metrics
+    # early stopping actually happened: some trials ran < max_t iterations
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < 9, iters
