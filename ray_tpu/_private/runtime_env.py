@@ -380,8 +380,157 @@ class _PipPlugin(RuntimeEnvPlugin):
                 sys.path.insert(0, sp)
 
 
-for _name in ("conda", "container"):
-    register_runtime_env_plugin(_UnsupportedPlugin(_name))
+class _CondaPlugin(RuntimeEnvPlugin):
+    """conda runtime env (ray parity:
+    python/ray/_private/runtime_env/conda.py), constrained like pip to
+    what an offline image can honor:
+
+    - ``{"conda": "env-name"}`` activates an EXISTING named env: its
+      site-packages are prepended to ``sys.path`` worker-side (the same
+      in-process activation the pip plugin uses for venvs).
+    - ``{"conda": {...env spec...}}`` (env creation) needs a conda binary
+      and network/channel access — validation fails EARLY with a clear
+      error if no conda binary is on this image, rather than at task time.
+    """
+
+    name = "conda"
+    priority = 8
+
+    @staticmethod
+    def _conda_exe():
+        import shutil as _sh
+
+        return (os.environ.get("CONDA_EXE")
+                or _sh.which("conda") or _sh.which("mamba"))
+
+    @classmethod
+    def _named_env_prefix(cls, name: str):
+        """Resolve a named env: cheap directory probes first
+        ($CONDA_PREFIX/envs/<name>, ~/.conda/envs/<name>, the root prefix
+        itself), then — so custom envs_dirs configurations resolve too —
+        `conda env list --json` when a binary exists."""
+        roots = []
+        base = os.environ.get("CONDA_PREFIX")
+        if base:
+            # CONDA_PREFIX may itself be an env dir; its parent of parent
+            # is the install root
+            roots += [base, os.path.dirname(os.path.dirname(base))]
+        roots.append(os.path.expanduser("~/.conda"))
+        for root in roots:
+            cand = os.path.join(root, "envs", name)
+            if os.path.isdir(cand):
+                return cand
+        if base and os.path.basename(base) == name:
+            return base
+        exe = cls._conda_exe()
+        if exe:
+            import json as _json
+            import subprocess
+
+            try:
+                out = subprocess.run(
+                    [exe, "env", "list", "--json"], capture_output=True,
+                    text=True, timeout=30,
+                )
+                for prefix in _json.loads(out.stdout or "{}").get(
+                    "envs", []
+                ):
+                    if os.path.basename(prefix) == name:
+                        return prefix
+            except Exception:
+                pass
+        return None
+
+    def validate(self, env: dict) -> None:
+        spec = env.get("conda")
+        if not spec:
+            return
+        if isinstance(spec, str):
+            if self._named_env_prefix(spec) is None and not self._conda_exe():
+                raise ValueError(
+                    f"runtime_env['conda'] names env {spec!r}, but no such "
+                    "env directory exists and no conda binary is available "
+                    "to resolve it. Pre-create the env on every node or "
+                    "use runtime_env['pip'] with a local wheelhouse."
+                )
+        elif isinstance(spec, dict):
+            if not self._conda_exe():
+                raise ValueError(
+                    "runtime_env['conda'] with an env spec needs a conda "
+                    "binary, which this image does not ship. Use a named "
+                    "pre-created env ({'conda': 'name'}) or "
+                    "runtime_env['pip'] with a local wheelhouse."
+                )
+        else:
+            raise ValueError(
+                "runtime_env['conda'] must be an env name or an env spec "
+                "dict"
+            )
+
+    def materialize(self, core_worker, env: dict) -> None:
+        import glob as _glob
+        import subprocess
+
+        spec = env.get("conda")
+        if not spec:
+            return
+        if isinstance(spec, dict):
+            exe = self._conda_exe()
+            if exe is None:
+                # validate ran driver-side; this node may differ
+                raise RuntimeError(
+                    "runtime_env['conda'] env spec: no conda binary on "
+                    "this node"
+                )
+            # env creation path: hash the spec; build in a private tmp
+            # prefix and publish with ONE atomic rename (same recipe as
+            # the pip venvs above — a failed or concurrent create must
+            # never leave a half-built prefix that later workers treat
+            # as ready)
+            digest = hashlib.sha256(
+                repr(sorted(spec.items())).encode()
+            ).hexdigest()[:16]
+            prefix = os.path.join(_cache_root(), f"condaenv_{digest}")
+            if not os.path.isdir(prefix):
+                import shutil
+                import tempfile
+
+                with tempfile.NamedTemporaryFile(
+                    "w", suffix=".yml", delete=False
+                ) as f:
+                    import yaml as _yaml
+
+                    _yaml.safe_dump(spec, f)
+                    spec_file = f.name
+                tmp = f"{prefix}.building.{os.getpid()}"
+                proc = subprocess.run(
+                    [exe, "env", "create", "-p", tmp, "-f", spec_file],
+                    capture_output=True, text=True,
+                )
+                if proc.returncode != 0:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeError(
+                        f"conda env create failed:\n{proc.stderr}"
+                    )
+                try:
+                    os.rename(tmp, prefix)
+                except OSError:  # lost the publish race: use the winner's
+                    shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            prefix = self._named_env_prefix(spec)
+            if prefix is None:
+                raise RuntimeError(
+                    f"conda env {spec!r} not found on this node"
+                )
+        for sp in _glob.glob(
+            os.path.join(prefix, "lib", "python*", "site-packages")
+        ):
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+
+
+register_runtime_env_plugin(_UnsupportedPlugin("container"))
+register_runtime_env_plugin(_CondaPlugin())
 register_runtime_env_plugin(_PipPlugin())
 register_runtime_env_plugin(_EnvVarsPlugin())
 register_runtime_env_plugin(_WorkingDirPlugin())

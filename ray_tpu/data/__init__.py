@@ -19,6 +19,7 @@ from ray_tpu.data.read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_mongo,
     read_sql,
     read_tfrecords,
     read_webdataset,
@@ -50,6 +51,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_mongo",
     "read_sql",
     "read_datasource",
     "Datasource",

@@ -139,3 +139,14 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = -1,
     return Dataset.from_read_tasks(
         ds.sql_tasks(sql, connection_factory, p), p
     )
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: int = -1, **_kw) -> Dataset:
+    """ray parity: read_mongo — _id-sliced partitioned read of a MongoDB
+    collection; requires pymongo (clear error here if absent)."""
+    p = _par(parallelism)
+    return Dataset.from_read_tasks(
+        ds.mongo_tasks(uri, database, collection, pipeline=pipeline,
+                       parallelism=p), p
+    )

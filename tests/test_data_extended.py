@@ -207,3 +207,14 @@ def test_custom_datasource_base(ray_start_regular):
     rows = read_datasource(Squares()).take_all()
     assert len(rows) == 30
     assert all(r["sq"] == r["x"] ** 2 for r in rows)
+
+
+def test_read_mongo_gated_on_pymongo():
+    """pymongo is absent in this image: read_mongo must raise the
+    documented ImportError at CALL time (not inside a worker task)."""
+    import pytest as _pytest
+
+    from ray_tpu import data as rd
+
+    with _pytest.raises(ImportError, match="pymongo"):
+        rd.read_mongo("mongodb://localhost:27017", "db", "coll")

@@ -76,7 +76,7 @@ def test_unsupported_plugins_fail_fast(ray_start_regular, monkeypatch):
     def nope2():
         return 1
 
-    with pytest.raises(ValueError, match="not supported"):
+    with pytest.raises(ValueError, match="env name or an env spec"):
         nope2.remote()
 
 

@@ -60,9 +60,8 @@ def test_plugin_validate_fails_fast():
 def test_unsupported_keys_still_raise():
     from ray_tpu._private.runtime_env import prepare_runtime_env
 
-    for key in ("conda", "container"):
-        with pytest.raises(ValueError, match="not supported"):
-            prepare_runtime_env(None, {key: ["anything"]})
+    with pytest.raises(ValueError, match="not supported"):
+        prepare_runtime_env(None, {"container": ["anything"]})
 
 
 def test_pip_without_wheelhouse_raises_documented_error(monkeypatch):
@@ -147,3 +146,45 @@ def test_pip_wheelhouse_env_end_to_end(ray_start_regular_fn, tmp_path):
     import importlib.util
 
     assert importlib.util.find_spec("rtpu_testwheel") is None
+
+
+def test_conda_named_env_activates(monkeypatch, tmp_path):
+    """{'conda': 'name'}: an existing env's site-packages join sys.path
+    worker-side; a missing env fails EARLY at validate (no conda binary
+    on this image)."""
+    import sys
+
+    from ray_tpu._private.runtime_env import (
+        _CondaPlugin,
+        prepare_runtime_env,
+    )
+
+    root = tmp_path / "miniconda"
+    sp = root / "envs" / "myenv" / "lib" / "python3.12" / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "conda_shipped_mod.py").write_text("VALUE = 41\n")
+    monkeypatch.setenv("CONDA_PREFIX", str(root))
+    monkeypatch.delenv("CONDA_EXE", raising=False)
+
+    env = prepare_runtime_env(None, {"conda": "myenv"})
+    plugin = _CondaPlugin()
+    try:
+        plugin.materialize(None, env)
+        import conda_shipped_mod
+
+        assert conda_shipped_mod.VALUE == 41
+    finally:
+        sys.path[:] = [p for p in sys.path if str(sp) != p]
+        sys.modules.pop("conda_shipped_mod", None)
+
+    with pytest.raises(ValueError, match="no such .?env"):
+        prepare_runtime_env(None, {"conda": "missing-env"})
+
+
+def test_conda_spec_without_binary_raises(monkeypatch):
+    monkeypatch.delenv("CONDA_EXE", raising=False)
+    monkeypatch.setenv("PATH", "/usr/bin:/bin")
+    from ray_tpu._private.runtime_env import prepare_runtime_env
+
+    with pytest.raises(ValueError, match="conda binary"):
+        prepare_runtime_env(None, {"conda": {"dependencies": ["numpy"]}})
