@@ -143,16 +143,20 @@ def main():
     if on_tpu:
         seq_len, steps, warmup = 1024, 10, 3
         config_cls = gpt2.GPT2Config.gpt2_124m
-        # Ordered most-promising-first. Round-4 finding (r4 OOM dump): the
-        # fused loss materialized [B,T,50257] logits in f32+bf16 (~18GB at
-        # batch 64) — loss_chunks=8 computes the loss in sequence chunks
-        # with logit recomputation, so large NO-remat batches fit; full-
-        # block remat measured 0.555x (FLOP overhead) and is kept only as
-        # a fallback point.
+        # Ordered most-promising-first, SAFEST first: through the relayed
+        # tunnel each compile can cost minutes, so config #1 must both fit
+        # memory and land a number. Round-4 findings: (a) loss_chunks=8
+        # keeps the [B,T,50257] logits from materializing; (b) "auto"
+        # attention lowers to plain XLA attention on the relayed backend,
+        # which SAVES the [B,H,T,T] probs for backward (~770MB/layer at
+        # batch 32 -> OOM without remat) — the Pallas flash path ("flash")
+        # recomputes them blockwise and never materializes the matrix;
+        # (c) full-block remat measured 0.555x (FLOP overhead): fallback
+        # only.
         sweep = [
-            (32, False, "auto", 8), (64, False, "auto", 8),
-            (16, False, "auto", 8), (64, True, "auto", 8),
-            (32, True, "auto", 0), (8, False, "auto", 0),
+            (16, False, "flash", 8), (32, False, "flash", 8),
+            (64, False, "flash", 8), (16, False, "auto", 8),
+            (64, True, "flash", 8), (8, False, "auto", 0),
         ]
     else:  # CPU smoke fallback so the bench always emits a line
         seq_len, steps, warmup = 128, 3, 1

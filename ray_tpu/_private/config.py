@@ -120,6 +120,10 @@ _flag("push_rx_expiry_s", float, 60.0)  # abandoned inbound push sessions
 _flag("worker_prestart", int, 2)
 # Direct task push over worker leases (ray: direct_task_transport.cc)
 _flag("direct_task_leases", bool, True)
+# blocked get() diagnostics: after this many seconds waiting on one ref, log
+# a WARNING with the direct-push transport state (and append it to
+# RAY_TPU_STALL_DUMP_FILE if set). 0 disables.
+_flag("get_stall_dump_s", float, 30.0)
 _flag("direct_lease_pipeline_depth", int, 4)  # in-flight tasks per lease
 _flag("direct_lease_max", int, 16)  # leases per scheduling class per driver
 _flag("direct_lease_linger_s", float, 0.5)  # idle hold before lease return

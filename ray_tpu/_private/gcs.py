@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Set
 
 from ray_tpu._private.common import NodeInfo, TaskSpec, place_bundles, res_fits
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
-from ray_tpu._private.rpcio import Connection, RpcServer
+from ray_tpu._private.rpcio import Connection, RpcServer, spawn
 
 logger = logging.getLogger(__name__)
 
@@ -198,10 +198,10 @@ class GcsServer:
 
     async def start(self):
         port = await self.server.start()
-        self._tasks.append(asyncio.get_running_loop().create_task(self._health_loop()))
+        self._tasks.append(spawn(self._health_loop()))
         if self._recovered:
             self._tasks.append(
-                asyncio.get_running_loop().create_task(self._finish_recovery())
+                spawn(self._finish_recovery())
             )
         self._started.set()
         logger.info("GCS listening on %s", port)
@@ -228,7 +228,7 @@ class GcsServer:
                 pg.state = "PENDING"
                 pg.bundle_nodes = [None] * len(pg.bundles)
                 self._persist_pg(pg)
-                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+                spawn(self._schedule_pg(pg))
         # Jobs whose driver never reconnected: treat the driver as dead (its
         # exit raced the GCS outage, so the disconnect cleanup never ran).
         live_jobs = {
@@ -323,7 +323,7 @@ class GcsServer:
             if pg.state == "INFEASIBLE":
                 pg.state = "PENDING"
                 self._persist_pg(pg)
-                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+                spawn(self._schedule_pg(pg))
         return {"node_id": node.node_id, "nodes": self._view()}
 
     async def _reconcile_node_state(self, node_id: str, state: dict):
@@ -584,7 +584,7 @@ class GcsServer:
                 continue
             self._pub_buf.setdefault(conn, []).append((channel, message))
         if self._pub_buf and self._pub_flush is None:
-            self._pub_flush = asyncio.get_running_loop().create_task(
+            self._pub_flush = spawn(
                 self._flush_pubsub()
             )
 
@@ -667,7 +667,7 @@ class GcsServer:
             self.named_actors[key] = rec.actor_id
         self.actors[rec.actor_id] = rec
         self._persist_actor(rec)
-        asyncio.get_running_loop().create_task(self._schedule_actor(rec))
+        spawn(self._schedule_actor(rec))
         return {"actor_id": rec.actor_id}
 
     async def _schedule_actor(self, rec: ActorRecord):
@@ -782,7 +782,7 @@ class GcsServer:
             rec.direct_addr = None
             await self._publish_actor(rec)
             await asyncio.sleep(cfg.actor_restart_delay_ms / 1000.0)
-            asyncio.get_running_loop().create_task(self._schedule_actor(rec))
+            spawn(self._schedule_actor(rec))
         else:
             await self._destroy_actor(rec, reason)
 
@@ -822,7 +822,7 @@ class GcsServer:
         )
         self.pgs[pg.pg_id] = pg
         self._persist_pg(pg)
-        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        spawn(self._schedule_pg(pg))
         return {"pg_id": pg.pg_id}
 
     async def _schedule_pg(self, pg: PlacementGroupRecord):

@@ -43,7 +43,7 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.rpcio import Connection, RpcServer, connect
+from ray_tpu._private.rpcio import Connection, RpcServer, connect, spawn
 
 logger = logging.getLogger(__name__)
 
@@ -310,24 +310,24 @@ class Raylet:
             "register_node", self._register_payload(), timeout=cfg.gcs_rpc_timeout_s
         )
         self._on_view(reply["nodes"])
-        self._tasks.append(asyncio.get_running_loop().create_task(self._heartbeat_loop()))
-        self._tasks.append(asyncio.get_running_loop().create_task(self._dispatch_loop()))
+        self._tasks.append(spawn(self._heartbeat_loop()))
+        self._tasks.append(spawn(self._dispatch_loop()))
         self._tasks.append(
-            asyncio.get_running_loop().create_task(self._memory_monitor_loop())
+            spawn(self._memory_monitor_loop())
         )
         self._tasks.append(
-            asyncio.get_running_loop().create_task(self._task_event_flush_loop())
+            spawn(self._task_event_flush_loop())
         )
         self._tasks.append(
-            asyncio.get_running_loop().create_task(self._infeasible_retry_loop())
+            spawn(self._infeasible_retry_loop())
         )
         self._tasks.append(
-            asyncio.get_running_loop().create_task(self._log_tailer_loop())
+            spawn(self._log_tailer_loop())
         )
         if cfg.enable_node_agent:
-            asyncio.get_running_loop().create_task(self._start_agent())
+            spawn(self._start_agent())
         if cfg.worker_prestart > 0:
-            asyncio.get_running_loop().create_task(self._prestart_workers())
+            spawn(self._prestart_workers())
         logger.info("raylet %s listening on %s", self.node_id[:8], self.port)
         return self.port
 
@@ -748,7 +748,7 @@ class Raylet:
         loop = asyncio.get_running_loop()
         for spec in stranded.values():
             spec.origin_node = None
-            t = loop.create_task(self._schedule_or_queue(spec))
+            t = spawn(self._schedule_or_queue(spec))
             self._bg_tasks.add(t)
             t.add_done_callback(self._bg_tasks.discard)
 
@@ -833,7 +833,7 @@ class Raylet:
         # the process exits, after the tailer's last tick — deliver it
         entry = self._tail_worker_log(w, final=True)
         if entry:
-            t = asyncio.get_running_loop().create_task(
+            t = spawn(
                 self._publish_worker_logs([entry])
             )
             self._bg_tasks.add(t)
@@ -979,7 +979,7 @@ class Raylet:
         q.append((spec, actor_addr))
         if spec.actor_id not in self._actor_routers:
             self._actor_routers.add(spec.actor_id)
-            asyncio.get_running_loop().create_task(
+            spawn(
                 self._actor_router(spec.actor_id)
             )
 
@@ -1039,7 +1039,7 @@ class Raylet:
             if q:  # a task slipped in during the finally window
                 if actor_id not in self._actor_routers:
                     self._actor_routers.add(actor_id)
-                    asyncio.get_running_loop().create_task(
+                    spawn(
                         self._actor_router(actor_id)
                     )
             else:
@@ -1133,7 +1133,7 @@ class Raylet:
                                   missing=len(missing))
             for oid in missing:
                 self.dep_waiters.setdefault(oid, []).append(spec.task_id)
-                asyncio.get_running_loop().create_task(self._pull_for_dep(oid))
+                spawn(self._pull_for_dep(oid))
         else:
             self.ready.append(qt)
             self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
@@ -1227,7 +1227,7 @@ class Raylet:
                     w.busy_with = qt.spec.task_id
                     self.running[qt.spec.task_id] = qt
                     self.counters["tasks_dispatched"] += 1
-                    asyncio.get_running_loop().create_task(
+                    spawn(
                         self._run_on_worker(qt, w)
                     )
             if retry:
@@ -1325,7 +1325,7 @@ class Raylet:
             )
             if not self._owner_flushing:
                 self._owner_flushing = True
-                asyncio.get_running_loop().create_task(
+                spawn(
                     self._flush_owner_outbox()
                 )
             return
@@ -1395,6 +1395,7 @@ class Raylet:
     async def _pop_worker_for(self, job_id: Optional[bytes],
                               runtime_env: Optional[dict]) -> Optional[_Worker]:
         env_hash = runtime_env_hash(runtime_env)
+        waited_s = 0.0
         while True:
             pool = self.idle_workers.get(env_hash)
             while pool:
@@ -1410,6 +1411,16 @@ class Raylet:
             waiting = self._spawn_waiters.get(env_hash, 0)
             if starting <= waiting:
                 break
+            if waited_s > cfg.worker_register_timeout_s * 2:
+                # Livelock breaker: no boot takes this long — a leaked
+                # _workers_starting count would otherwise park every
+                # lease/dispatch for this env forever. Spawn our own.
+                logger.error(
+                    "spawn-wait exceeded %.0fs (starting=%d waiting=%d "
+                    "env=%s); breaking out to spawn directly",
+                    waited_s, starting, waiting, env_hash[:8],
+                )
+                break
             self._spawn_waiters[env_hash] = waiting + 1
             try:
                 await asyncio.wait_for(self._worker_started.wait(), 0.25)
@@ -1417,6 +1428,7 @@ class Raylet:
                 pass
             finally:
                 self._spawn_waiters[env_hash] -= 1
+            waited_s += 0.25
             self._worker_started.clear()
         n_alive = len(self.all_workers)
         if n_alive >= cfg.num_workers_soft_limit:
@@ -1506,16 +1518,24 @@ class Raylet:
         ehash = w.env_hash
         self._workers_starting[ehash] = \
             self._workers_starting.get(ehash, 0) + 1
+        logger.info("spawning worker pid=%s env=%s (starting=%d)",
+                    proc.pid, ehash[:8], self._workers_starting[ehash])
         try:
             await asyncio.wait_for(w.registered, cfg.worker_register_timeout_s)
         except asyncio.TimeoutError:
-            logger.error("worker %s failed to register", proc.pid)
+            logger.error(
+                "worker %s failed to register within %.0fs (proc %s)",
+                proc.pid, cfg.worker_register_timeout_s,
+                "alive" if proc.poll() is None
+                else f"exited rc={proc.returncode}",
+            )
             proc.kill()
             self.all_workers.pop(proc.pid, None)
             return None
         finally:
             self._workers_starting[ehash] -= 1
             self._worker_started.set()
+        logger.info("worker pid=%s registered", proc.pid)
         return w
 
     # ------------------------------------------------------------------
@@ -1562,7 +1582,7 @@ class Raylet:
         # Local actor: push straight to its worker.
         w = self.local_actors.get(spec.actor_id)
         if w is not None and w.conn is not None and not w.conn.closed:
-            asyncio.get_running_loop().create_task(self._run_actor_task(spec, w))
+            spawn(self._run_actor_task(spec, w))
             return
         addr = actor_addr or self.actor_addr_cache.get(spec.actor_id)
         if addr is None or addr[0] == self.node_id:
@@ -1610,7 +1630,7 @@ class Raylet:
                     spec, "actor node unreachable", retriable=True
                 )
 
-        asyncio.get_running_loop().create_task(_forward())
+        spawn(_forward())
 
     async def _run_actor_task(self, spec: TaskSpec, w: _Worker):
         try:
@@ -1920,7 +1940,7 @@ class Raylet:
                     payload["metadata"] = buf.metadata
                 await sem.acquire()
                 sends.append(
-                    asyncio.get_running_loop().create_task(send(payload))
+                    spawn(send(payload))
                 )
                 off += len(data)
                 if off >= total:

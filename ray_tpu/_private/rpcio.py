@@ -14,6 +14,7 @@ reference's separation of gRPC control from plasma/object-manager data.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import hashlib
 import hmac
 import itertools
@@ -212,9 +213,9 @@ class Connection:
                     if fut and not fut.done():
                         fut.set_exception(RpcError(payload))
                 else:
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(msg_id, kind, method, payload)
-                    )
+                    # spawn (strong ref): a GC'd dispatch task would drop
+                    # the request without ever sending a reply
+                    spawn(self._dispatch(msg_id, kind, method, payload))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         except asyncio.CancelledError:
@@ -225,6 +226,12 @@ class Connection:
             await self._do_close()
 
     async def _dispatch(self, msg_id: int, kind: int, method: str, payload):
+        task = asyncio.current_task()
+        if task is not None:
+            # name = the method being served: SIGUSR2 task dumps then show
+            # WHICH handler a wedged dispatch is stuck in, not just that
+            # one is stuck (negligible cost next to unpickle+handler)
+            task.set_name(f"dispatch:{method}:{self.name}")
         handler = self.handler
         fn = getattr(handler, f"rpc_{method}", None) if handler else None
         if fn is None:
@@ -363,6 +370,42 @@ async def connect(host: str, port: int, handler=None, name: str = "client",
     raise ConnectionLost(f"cannot connect to {host}:{port}: {last}")
 
 
+_BG_TASKS: set = set()
+
+
+def spawn(coro, name: str = None) -> asyncio.Task:
+    """create_task with a STRONG reference held until completion, plus
+    dropped-exception logging. The event loop keeps only weak task refs: a
+    fire-and-forget task awaiting a future that is reachable only from the
+    task itself forms an unrooted cycle the GC may collect mid-await —
+    silently skipping the coroutine's finally blocks. (Observed in round 4:
+    a collected pump task left its registry key behind and stranded every
+    subsequent task of its scheduling class.) Every fire-and-forget
+    create_task in system processes must go through here or an equivalent
+    live structure."""
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _BG_TASKS.add(task)
+
+    def _done(t):
+        _BG_TASKS.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            logger.error("background task %s failed: %r", t.get_name(),
+                         t.exception(), exc_info=t.exception())
+
+    task.add_done_callback(_done)
+    return task
+
+
+def _log_dropped_exception(fut) -> None:
+    try:
+        exc = fut.exception()
+    except (asyncio.CancelledError, concurrent.futures.CancelledError):
+        return
+    if exc is not None:
+        logger.error("fire-and-forget coroutine failed: %r", exc,
+                     exc_info=exc)
+
+
 class EventLoopThread:
     """A dedicated asyncio loop on a daemon thread, for sync callers.
 
@@ -386,7 +429,13 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def call_soon(self, coro):
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        # Fire-and-forget callers never .result() this future, and
+        # run_coroutine_threadsafe swallows coroutine exceptions into it —
+        # a crashed submit/registration coroutine would strand its task
+        # forever with no trace. Surface the loss loudly instead.
+        fut.add_done_callback(_log_dropped_exception)
+        return fut
 
     def stop(self):
         def _cancel_all():

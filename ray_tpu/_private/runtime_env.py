@@ -503,10 +503,16 @@ class _CondaPlugin(RuntimeEnvPlugin):
                     _yaml.safe_dump(spec, f)
                     spec_file = f.name
                 tmp = f"{prefix}.building.{os.getpid()}"
-                proc = subprocess.run(
-                    [exe, "env", "create", "-p", tmp, "-f", spec_file],
-                    capture_output=True, text=True,
-                )
+                try:
+                    proc = subprocess.run(
+                        [exe, "env", "create", "-p", tmp, "-f", spec_file],
+                        capture_output=True, text=True,
+                    )
+                finally:
+                    try:
+                        os.unlink(spec_file)
+                    except OSError:
+                        pass
                 if proc.returncode != 0:
                     shutil.rmtree(tmp, ignore_errors=True)
                     raise RuntimeError(

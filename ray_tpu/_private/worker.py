@@ -180,11 +180,26 @@ class CoreWorker:
         # tick-batched task submission buffer (see _submit_when_ready)
         self._submit_buf: List[TaskSpec] = []
         self._submit_flushing = False
+        # submission-stage breadcrumbs (task_id -> last stage string):
+        # costs one dict write per transition and makes a stranded task
+        # diagnosable from the get()-stall dump — which stage ate it.
+        self._submit_stage: Dict[bytes, str] = {}
+        # Strong refs for fire-and-forget io-loop tasks. asyncio's loop
+        # holds only WEAK task references: an unreferenced pending task can
+        # be garbage-collected mid-await, silently skipping its finally
+        # (observed: a GC'd _direct_pump left its key registered forever,
+        # stranding every later task of that scheduling class — the
+        # round-4 full-suite hang). Every create_task here must land in
+        # this set (or another live structure) until done.
+        self._bg_tasks: set = set()
         # direct task push over worker leases (ray:
         # direct_task_transport.cc): per-scheduling-class pending queues,
         # one pump task per active class, cached conns to leased workers
         self._direct_q: Dict[tuple, deque] = {}
-        self._direct_pumps: set = set()
+        # key -> live pump task; the TASK OBJECT is stored (strong ref, see
+        # _bg_tasks note) and checked with .done() so a crashed/GC'd pump
+        # self-heals on the next enqueue instead of stranding the class
+        self._direct_pumps: Dict[tuple, object] = {}
         self._direct_conns: Dict[tuple, Connection] = {}
         self._direct_events: Dict[tuple, asyncio.Event] = {}
         # direct actor calls: actor_id -> {"q", "running", "conn"}
@@ -281,8 +296,25 @@ class CoreWorker:
             return ("v", inline[0], inline[1])
         return ("r", ref.binary(), ref.owner or self.addr)
 
+    def _spawn(self, coro) -> "asyncio.Task":
+        """create_task + keep a strong reference until completion (asyncio
+        keeps only weak refs — see _bg_tasks) + surface dropped
+        exceptions."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+
+        def _done(t):
+            self._bg_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                logger.error("background io task failed: %r", t.exception(),
+                             exc_info=t.exception())
+
+        task.add_done_callback(_done)
+        return task
+
     async def _submit_when_ready(self, spec: TaskSpec, enc_args, enc_kwargs,
                                  pending: List[ObjectRef], pins: List):
+        self._submit_stage[spec.task_id] = "deps_wait"
         try:
             for ref in pending:
                 fut = self.future_for(ref)
@@ -292,6 +324,7 @@ class CoreWorker:
         except Exception as e:
             self._fail_returns(spec, f"dependency resolution failed: {e}")
             return
+        self._submit_stage[spec.task_id] = "finalizing"
         spec.args = [self._finalize_slot(s, pins) for s in enc_args]
         spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
         with self._lock:
@@ -302,6 +335,7 @@ class CoreWorker:
         # Placement-sensitive strategies stay raylet-routed.
         if (cfg.direct_task_leases and spec.actor_id is None
                 and spec.scheduling.kind == "DEFAULT"):
+            self._submit_stage[spec.task_id] = "direct_enqueued"
             self._direct_enqueue(spec)
             return
         # Actor calls push straight to the actor worker's own endpoint
@@ -310,6 +344,7 @@ class CoreWorker:
         # back to raylet routing when no direct endpoint is known.
         if (cfg.direct_actor_calls and spec.actor_id is not None
                 and not spec.actor_creation):
+            self._submit_stage[spec.task_id] = "actor_enqueued"
             self._actor_direct_enqueue(spec)
             return
         # Tick-batched submission: a burst of .remote() calls lands on the
@@ -318,10 +353,11 @@ class CoreWorker:
         # GCS pubsub outbox). Actor tasks ride the same buffer: the buffer
         # is FIFO and the raylet enqueues a batch's actor tasks
         # synchronously in spec order, so per-actor call order survives.
+        self._submit_stage[spec.task_id] = "batch_buffered"
         self._submit_buf.append(spec)
         if not self._submit_flushing:
             self._submit_flushing = True
-            asyncio.get_running_loop().create_task(self._flush_submits())
+            self._spawn(self._flush_submits())
 
     async def _flush_submits(self):
         await asyncio.sleep(0)  # one tick: let same-burst submissions land
@@ -331,6 +367,8 @@ class CoreWorker:
             return
         try:
             await self.raylet.request("submit_batch", {"specs": batch})
+            for spec in batch:
+                self._submit_stage[spec.task_id] = "raylet_accepted"
         except Exception as e:
             for spec in batch:
                 self._fail_returns(spec, f"task submission failed: {e}")
@@ -343,9 +381,9 @@ class CoreWorker:
         if ev is None:
             ev = self._direct_events[key] = asyncio.Event()
         ev.set()
-        if key not in self._direct_pumps:
-            self._direct_pumps.add(key)
-            asyncio.get_running_loop().create_task(self._direct_pump(key))
+        t = self._direct_pumps.get(key)
+        if t is None or t.done():
+            self._direct_pumps[key] = self._spawn(self._direct_pump(key))
 
     async def _direct_pump(self, key: tuple):
         """One pump per scheduling class: lease workers from the raylet,
@@ -377,6 +415,8 @@ class CoreWorker:
                         await self.raylet.request(
                             "submit_batch", {"specs": batch}
                         )
+                        for s in batch:
+                            self._submit_stage[s.task_id] = "raylet_no_lease"
                     except Exception as e:
                         for s in batch:
                             self._fail_returns(
@@ -394,6 +434,8 @@ class CoreWorker:
                         await self.raylet.request(
                             "submit_batch", {"specs": tail}
                         )
+                        for s in tail:
+                            self._submit_stage[s.task_id] = "raylet_spill"
                     except Exception as e:
                         for s in tail:
                             self._fail_returns(
@@ -402,10 +444,18 @@ class CoreWorker:
                 loop = asyncio.get_running_loop()
                 ev = self._direct_events[key]
                 feeders = [
-                    loop.create_task(self._direct_feed(lease, q, ev))
+                    self._spawn(self._direct_feed(lease, q, ev))
                     for lease in leases for _ in range(depth)
                 ]
-                await asyncio.gather(*feeders)
+                # return_exceptions: one crashed feeder must not kill the
+                # pump before the leases are returned — a dead pump strands
+                # the lease's reserved CPU and every spec still queued.
+                for res in await asyncio.gather(
+                    *feeders, return_exceptions=True
+                ):
+                    if isinstance(res, BaseException):
+                        logger.error("direct feeder crashed: %r", res,
+                                     exc_info=res)
                 for lease in leases:
                     try:
                         await self.raylet.notify(
@@ -414,13 +464,9 @@ class CoreWorker:
                     except Exception:
                         pass
         finally:
-            self._direct_pumps.discard(key)
+            self._direct_pumps.pop(key, None)
             if q:  # a burst landed during the finally window: restart
-                if key not in self._direct_pumps:
-                    self._direct_pumps.add(key)
-                    asyncio.get_running_loop().create_task(
-                        self._direct_pump(key)
-                    )
+                self._direct_pumps[key] = self._spawn(self._direct_pump(key))
             else:
                 self._direct_q.pop(key, None)
 
@@ -461,15 +507,36 @@ class CoreWorker:
                 # retry attempt (at-most-once was never at risk)
                 try:
                     await self.raylet.request("submit_task", {"spec": spec})
+                    self._submit_stage[spec.task_id] = "raylet_reroute"
                 except Exception as e:
                     self._fail_returns(spec, f"task submission failed: {e}")
                 return
+            self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
             try:
                 result = await conn.request("execute_task", {"spec": spec})
             except Exception:
-                await self._direct_worker_lost(spec, lease)
+                self._submit_stage[spec.task_id] = "worker_lost"
+                try:
+                    await self._direct_worker_lost(spec, lease)
+                except Exception:
+                    logger.exception(
+                        "direct-push loss handling failed for %s", spec.name
+                    )
+                    self._fail_returns(spec, "leased worker lost")
                 return
-            await self._direct_result(spec, result)
+            # The spec is consumed from the queue: any failure past this
+            # point MUST still resolve the task's returns, or the caller's
+            # get() blocks forever on an object nobody will produce.
+            self._submit_stage[spec.task_id] = "resulted"
+            try:
+                await self._direct_result(spec, result)
+            except Exception as e:
+                logger.exception(
+                    "direct result processing failed for %s", spec.name
+                )
+                self._fail_returns(
+                    spec, f"internal error processing task result: {e!r}"
+                )
 
     # -- direct actor calls --------------------------------------------
     def _actor_direct_enqueue(self, spec: TaskSpec):
@@ -482,9 +549,7 @@ class CoreWorker:
         st["q"].append(spec)
         if not st["running"]:
             st["running"] = True
-            asyncio.get_running_loop().create_task(
-                self._actor_sender(spec.actor_id, st)
-            )
+            self._spawn(self._actor_sender(spec.actor_id, st))
 
     async def _actor_sender(self, actor_id: bytes, st: dict):
         """Single sender per actor: pipelined in-order request_nowait
@@ -548,14 +613,14 @@ class CoreWorker:
                     st["relost"].append(spec)
                     continue
                 st["inflight"] += 1
-                loop.create_task(
+                self._spawn(
                     self._actor_direct_reply(actor_id, st, spec, fut)
                 )
         finally:
             st["running"] = False
             if (st["q"] or st["relost"]) and not st["running"]:
                 st["running"] = True
-                loop.create_task(self._actor_sender(actor_id, st))
+                self._spawn(self._actor_sender(actor_id, st))
 
     async def _actor_direct_connect(self, actor_id: bytes):
         try:
@@ -581,23 +646,41 @@ class CoreWorker:
         try:
             result = await fut
         except Exception:
-            # worker died / restarting: flip to sticky raylet fallback and
-            # park the call for the sender's seq-ordered recovery drain
+            # Worker died / restarting: flip to sticky raylet fallback. The
+            # call was SENT, so its fate is unknown — at-most-once actor
+            # semantics (ray: actor tasks are NOT retried unless
+            # max_task_retries is set) forbid blind resubmission: a
+            # side-effecting call like `die()` would re-execute against the
+            # restarted incarnation and burn its max_restarts budget.
             st["fallback"] = True
             if st.get("conn") is not None and st["conn"].closed:
                 st["conn"] = None
-            st["relost"].append(spec)
+            if spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                st["relost"].append(spec)
+            else:
+                self._fail_returns_exc(spec, ActorDiedError(
+                    f"The actor died while this call was in flight; actor "
+                    f"tasks run at-most-once and are not retried unless "
+                    f"max_task_retries is set (method {spec.name!r})."
+                ))
             st["inflight"] -= 1
             st["settled"].set()
             if not st["running"]:
                 st["running"] = True
-                asyncio.get_running_loop().create_task(
-                    self._actor_sender(actor_id, st)
-                )
+                self._spawn(self._actor_sender(actor_id, st))
             return
         st["inflight"] -= 1
         st["settled"].set()
-        await self._direct_result(spec, result)
+        try:
+            await self._direct_result(spec, result)
+        except Exception as e:
+            logger.exception(
+                "actor-direct result processing failed for %s", spec.name
+            )
+            self._fail_returns(
+                spec, f"internal error processing task result: {e!r}"
+            )
 
     async def _direct_worker_lost(self, spec: TaskSpec,
                                   lease: Optional[dict] = None):
@@ -652,8 +735,12 @@ class CoreWorker:
             self.unpin_object(token)
 
     def _fail_returns(self, spec: TaskSpec, message: str):
-        sv = serialization.serialize_error(RuntimeError(message), spec.name)
+        self._fail_returns_exc(spec, RuntimeError(message))
+
+    def _fail_returns_exc(self, spec: TaskSpec, exc: Exception):
+        sv = serialization.serialize_error(exc, spec.name)
         tid = TaskID(spec.task_id)
+        self._submit_stage.pop(spec.task_id, None)
         with self._lock:
             self._specs_inflight.pop(spec.task_id, None)
         for i in range(max(1, spec.num_returns)):
@@ -971,6 +1058,7 @@ class CoreWorker:
             await self._handle_task_error(spec, task_id, p)
             return
         results = p["results"] or []
+        self._submit_stage.pop(task_id, None)
         with self._lock:
             self._specs_inflight.pop(task_id, None)
         tid = TaskID(task_id)
@@ -1117,6 +1205,7 @@ class CoreWorker:
                 return
             except Exception:
                 pass
+        self._submit_stage.pop(task_id, None)
         with self._lock:
             self._specs_inflight.pop(task_id, None)
         tid = TaskID(task_id)
@@ -1270,7 +1359,7 @@ class CoreWorker:
         self._tev_buf.append(ev)
         if not self._tev_flushing:
             self._tev_flushing = True
-            asyncio.get_running_loop().create_task(self._flush_task_events())
+            self._spawn(self._flush_task_events())
 
     async def _flush_task_events(self):
         buf, self._tev_buf = self._tev_buf, []
@@ -1491,13 +1580,102 @@ class CoreWorker:
         for r, f in zip(refs, futs):
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
-                kind, meta, data = f.result(remaining)
+                if remaining is None and cfg.get_stall_dump_s > 0:
+                    kind, meta, data = self._wait_with_stall_dump(r, f)
+                else:
+                    kind, meta, data = f.result(remaining)
             except concurrent.futures.TimeoutError:
                 raise GetTimeoutError(
                     f"Get timed out: {r} not ready after {timeout}s"
                 ) from None
             values.append(self._materialize(r, kind, meta, data))
         return values[0] if single else values
+
+    def _wait_with_stall_dump(self, ref: ObjectRef, f):
+        """Untimed get(): wait in stall-sized slices so a result that never
+        arrives produces a transport-state diagnostic instead of a silent
+        hang (the WARNING is the user-visible symptom; the dump file is for
+        postmortems)."""
+        stalls = 0
+        while True:
+            try:
+                return f.result(cfg.get_stall_dump_s)
+            except concurrent.futures.TimeoutError:
+                stalls += 1
+                dump = self.debug_transport_state()
+                msg = (f"get() blocked {stalls * cfg.get_stall_dump_s:.0f}s "
+                       f"on {ref}; transport state: {dump}")
+                logger.warning(msg)
+                path = os.environ.get("RAY_TPU_STALL_DUMP_FILE")
+                if path:
+                    try:
+                        with open(path, "a") as fh:
+                            fh.write(msg + "\n")
+                            if stalls == 3:
+                                # one-shot deep dump: the io loop's pending
+                                # task stacks localize a wedged coroutine
+                                # that the transport counters can't
+                                import io as _io
+
+                                buf = _io.StringIO()
+                                try:
+                                    from ray_tpu._private.profiling import \
+                                        all_asyncio_tasks
+
+                                    for t in all_asyncio_tasks():
+                                        if not t.done():
+                                            buf.write(f"--- {t!r} ---\n")
+                                            t.print_stack(file=buf)
+                                except Exception as de:
+                                    buf.write(f"(dump failed: {de!r})\n")
+                                fh.write(buf.getvalue())
+                    except OSError:
+                        pass
+
+    def debug_transport_state(self) -> dict:
+        """Snapshot of the direct-push machinery, readable without the io
+        loop (diagnosis only). Every container is list()-snapshotted before
+        iteration and the whole read is exception-guarded: the io thread
+        mutates these dicts concurrently, and a diagnostic must never turn
+        a healthy (if slow) get() into a RuntimeError."""
+        try:
+            state: dict = {
+                "direct_q": {
+                    repr(k): len(q) for k, q in list(self._direct_q.items())
+                },
+                "pumps": {
+                    repr(k): ("done" if t.done() else "live")
+                    for k, t in list(self._direct_pumps.items())
+                },
+                "bg_tasks": len(self._bg_tasks),
+                "events_set": {
+                    repr(k): ev.is_set()
+                    for k, ev in list(self._direct_events.items())
+                },
+                "direct_conns": {
+                    f"{h}:{p}": {
+                        "closed": c.closed, "pending": len(c._pending),
+                    }
+                    for (h, p), c in list(self._direct_conns.items())
+                },
+                "raylet_pending": len(self.raylet._pending)
+                if self.raylet is not None else None,
+                "specs_inflight": {
+                    tid.hex()[:8]: (s.name, self._submit_stage.get(tid, "?"))
+                    for tid, s in list(self._specs_inflight.items())[:16]
+                },
+                "actor_direct": {
+                    aid.hex()[:8]: {
+                        "q": len(st["q"]), "running": st["running"],
+                        "inflight": st.get("inflight"),
+                        "fallback": st.get("fallback", False),
+                    }
+                    for aid, st in list(self._actor_direct.items())
+                },
+            }
+        except Exception as e:  # torn read mid-mutation: partial is fine
+            state = {"error": f"snapshot failed: {e!r}"}
+        return state
 
     def _materialize(self, ref: ObjectRef, kind, meta, data):
         if kind == "inline":

@@ -86,6 +86,11 @@ class Agent:
 
 
 async def amain(args) -> None:
+    # Capture the owner's pid FIRST: if the raylet is SIGKILLed during our
+    # startup window, a later getppid() would already read the reparented
+    # value (1) and the orphan check below would never fire.
+    ppid = os.getppid()
+
     from aiohttp import web
 
     agent = Agent(args.raylet_port, args.session_dir)
@@ -104,9 +109,14 @@ async def amain(args) -> None:
         with open(tmp, "w") as f:
             f.write(str(port))
         os.replace(tmp, args.port_file)
-    # park; the owning raylet kills us on shutdown
-    while True:
-        await asyncio.sleep(3600)
+    # Park until the owning raylet goes away. Normal shutdown kills us
+    # explicitly, but a SIGKILLed raylet (chaos tests, OOM killer) cannot —
+    # detect that by watching for reparenting: the raylet spawns the agent
+    # as a direct child, so a PPID change means the owner is gone. Without
+    # this, every killed node leaks an agent process that lingers and
+    # re-dials its old raylet port after the port number is recycled.
+    while os.getppid() == ppid:
+        await asyncio.sleep(2.0)
 
 
 def main(argv: Optional[list] = None):

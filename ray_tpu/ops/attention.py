@@ -453,8 +453,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """
     sm_scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" and _HAS_PALLAS \
-            else "scan"
+        # devices()[0].platform, not default_backend(): relayed/experimental
+        # PJRT plugins (axon tunnel) register under their own backend name
+        # while the device platform still reports "tpu" — default_backend()
+        # alone would silently drop the TPU onto the scan fallback.
+        try:
+            on_tpu = (jax.default_backend() == "tpu"
+                      or jax.devices()[0].platform == "tpu")
+        except Exception:
+            on_tpu = False
+        impl = "pallas" if on_tpu and _HAS_PALLAS else "scan"
     if impl == "reference":
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "scan":

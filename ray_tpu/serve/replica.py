@@ -193,7 +193,9 @@ class Replica:
                 loop.call_soon_threadsafe(_finish)
 
         if inspect.isasyncgenfunction(unbound):
-            loop.create_task(_drive_async())
+            from ray_tpu._private.rpcio import spawn
+
+            spawn(_drive_async())  # strong ref until done + error logging
         else:
             self._pool.submit(_drive_sync)
         return {STREAM_MARKER: {"stream_id": sid, "replica": self._name}}

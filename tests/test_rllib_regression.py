@@ -11,7 +11,12 @@ def test_run_regression_all_configs():
         capture_output=True, text=True, timeout=540,
     )
     assert out.returncode == 0, out.stdout + out.stderr
-    assert "3/3 regression configs passed" in out.stdout, out.stdout
+    # count-agnostic: configs get added over time; all must pass
+    import re
+
+    m = re.search(r"(\d+)/(\d+) regression configs passed", out.stdout)
+    assert m is not None, out.stdout
+    assert m.group(1) == m.group(2) and int(m.group(2)) >= 3, out.stdout
 
 
 def test_select_filter_and_missing():
