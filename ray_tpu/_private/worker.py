@@ -177,9 +177,13 @@ class CoreWorker:
         # set() lost to a race costs 0.5s, not forever (and the idle drain
         # thread no longer wakes 50x/s on every process)
         self._release_event = threading.Event()
-        # tick-batched task submission buffer (see _submit_when_ready)
+        # tick-batched task submission buffer (see _finish_submit)
         self._submit_buf: List[TaskSpec] = []
         self._submit_flushing = False
+        # cross-thread submission inbox (see _enqueue_submit)
+        self._submit_inbox: deque = deque()
+        self._inbox_lock = threading.Lock()
+        self._inbox_scheduled = False
         # submission-stage breadcrumbs (task_id -> last stage string):
         # costs one dict write per transition and makes a stranded task
         # diagnosable from the get()-stall dump — which stage ate it.
@@ -324,6 +328,23 @@ class CoreWorker:
         except Exception as e:
             self._fail_returns(spec, f"dependency resolution failed: {e}")
             return
+        self._finish_submit(spec, enc_args, enc_kwargs, pins)
+
+    def _finish_submit(self, spec: TaskSpec, enc_args, enc_kwargs,
+                       pins: List):
+        """Synchronous tail of submission (deps already resolved). Runs
+        directly inside the inbox drain for the common no-deps case — no
+        per-call coroutine/task — and from _submit_when_ready otherwise.
+        Self-guarding: any failure here fails the task's returns so both
+        paths surface errors instead of hanging the caller's get()."""
+        try:
+            self._finish_submit_inner(spec, enc_args, enc_kwargs, pins)
+        except Exception as e:
+            logger.exception("submission failed for %s", spec.name)
+            self._fail_returns(spec, f"task submission failed: {e!r}")
+
+    def _finish_submit_inner(self, spec: TaskSpec, enc_args, enc_kwargs,
+                             pins: List):
         self._submit_stage[spec.task_id] = "finalizing"
         spec.args = [self._finalize_slot(s, pins) for s in enc_args]
         spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
@@ -348,16 +369,70 @@ class CoreWorker:
             self._actor_direct_enqueue(spec)
             return
         # Tick-batched submission: a burst of .remote() calls lands on the
-        # io loop as many _submit_when_ready tasks in the same tick; buffer
-        # them and ship ONE submit_batch frame (same discipline as the
-        # GCS pubsub outbox). Actor tasks ride the same buffer: the buffer
-        # is FIFO and the raylet enqueues a batch's actor tasks
-        # synchronously in spec order, so per-actor call order survives.
+        # io loop as one inbox drain; buffer and ship ONE submit_batch
+        # frame (same discipline as the GCS pubsub outbox). Actor tasks
+        # ride the same buffer: the buffer is FIFO and the raylet enqueues
+        # a batch's actor tasks synchronously in spec order, so per-actor
+        # call order survives.
         self._submit_stage[spec.task_id] = "batch_buffered"
         self._submit_buf.append(spec)
         if not self._submit_flushing:
             self._submit_flushing = True
             self._spawn(self._flush_submits())
+
+    def _enqueue_submit(self, spec: TaskSpec, enc_args, enc_kwargs,
+                        pending: List[ObjectRef], pins: List):
+        """Called from the (sync) submitting thread. One loop wakeup per
+        burst: run_coroutine_threadsafe costs ~175us per call (Task +
+        cross-thread handle + wakeup-fd write); a deque append plus a
+        single coalesced call_soon_threadsafe turns a 1000-task burst's
+        1000 wakeups into one."""
+        self._submit_inbox.append((spec, enc_args, enc_kwargs, pending, pins))
+        with self._inbox_lock:
+            if self._inbox_scheduled:
+                return
+            self._inbox_scheduled = True
+        try:
+            self.io.loop.call_soon_threadsafe(self._drain_submit_inbox)
+        except RuntimeError:
+            # loop closed (shutdown race): un-latch so later submissions
+            # raise here too instead of silently piling into a dead inbox
+            with self._inbox_lock:
+                self._inbox_scheduled = False
+            raise
+
+    def _drain_submit_inbox(self):
+        """On the io loop: drain queued submissions in FIFO order. Specs
+        with unresolved deps get a waiter task; the rest route
+        synchronously (no coroutine at all). Bounded per callback: a
+        producer thread submitting at or above the drain rate must not
+        starve the loop's other callbacks (socket flushes, result
+        delivery), so only the entries present at entry are drained and a
+        fresh callback is scheduled for any remainder."""
+        with self._inbox_lock:
+            self._inbox_scheduled = False
+        for _ in range(len(self._submit_inbox)):
+            try:
+                spec, enc_args, enc_kwargs, pending, pins = \
+                    self._submit_inbox.popleft()
+            except IndexError:
+                break
+            try:
+                if pending:
+                    self._spawn(self._submit_when_ready(
+                        spec, enc_args, enc_kwargs, pending, pins
+                    ))
+                else:
+                    self._finish_submit(spec, enc_args, enc_kwargs, pins)
+            except Exception as e:
+                logger.exception("submission failed for %s", spec.name)
+                self._fail_returns(spec, f"task submission failed: {e!r}")
+        if self._submit_inbox:
+            with self._inbox_lock:
+                if self._inbox_scheduled:
+                    return
+                self._inbox_scheduled = True
+            asyncio.get_running_loop().call_soon(self._drain_submit_inbox)
 
     async def _flush_submits(self):
         await asyncio.sleep(0)  # one tick: let same-burst submissions land
@@ -809,9 +884,7 @@ class CoreWorker:
             tracing_ctx=_tracing_ctx(),
         )
         refs = self._register_returns(spec)
-        self.io.call_soon(
-            self._submit_when_ready(spec, enc_args, enc_kwargs, pending, pins)
-        )
+        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
         return refs
 
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -973,9 +1046,7 @@ class CoreWorker:
             concurrency_group=concurrency_group,
         )
         refs = self._register_returns(spec)
-        self.io.call_soon(
-            self._submit_when_ready(spec, enc_args, enc_kwargs, pending, pins)
-        )
+        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
         return refs
 
     def get_actor_table(self, actor_id: Optional[bytes] = None,
