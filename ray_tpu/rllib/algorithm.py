@@ -31,7 +31,11 @@ from ray_tpu.rllib.learner import (
     SACLearner,
     TD3Learner,
 )
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    n_step_transform,
+)
 from ray_tpu.rllib.rl_module import RLModule
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae, returns_to_go
 from ray_tpu.tune.trainable import Trainable
@@ -197,8 +201,10 @@ class Algorithm(Trainable):
         if hasattr(probe, "close"):
             probe.close()
         hiddens = tuple(cfg.model.get("hiddens", (64, 64)))
+        dueling = bool(getattr(cfg, "dueling", False))
         self.module = RLModule(
             obs_shape, num_actions, seed=cfg.seed, hiddens=hiddens,
+            dueling=dueling,
         )
         if getattr(cfg, "num_learners", 0) >= 1:
             # Multi-learner plane: N learner actors, DDP gradient sync.
@@ -217,7 +223,8 @@ class Algorithm(Trainable):
             seed, model_hiddens = cfg.seed, hiddens
 
             def module_factory(_shape=obs_shape, _n=num_actions):
-                return RLModule(_shape, _n, seed=seed, hiddens=model_hiddens)
+                return RLModule(_shape, _n, seed=seed, hiddens=model_hiddens,
+                                dueling=dueling)
 
             self.learner = LearnerGroup(
                 self._learner_cls, module_factory, cfg,
@@ -243,7 +250,7 @@ class Algorithm(Trainable):
         )(EnvRunner)
         self._runner_factory = lambda i, replacement=False: runner_cls.remote(
             cfg.env, cfg.env_config,
-            {"hiddens": tuple(cfg.model.get("hiddens", (64, 64)))},
+            {"hiddens": hiddens, "dueling": dueling},
             seed=cfg.seed + i,
             observation_filter=getattr(cfg, "observation_filter", None),
         )
@@ -621,26 +628,46 @@ class APPO(Algorithm):
 
 
 class DQN(Algorithm):
+    """DQN with the reference's rainbow-family options on by default:
+    double-Q, dueling heads, optional n-step returns, and prioritized
+    replay (ray parity: rllib/algorithms/dqn)."""
+
     _learner_cls = DQNLearner
 
     def setup(self, config):
         super().setup(config)
-        self.buffer = ReplayBuffer(self._algo_config.replay_buffer_capacity,
-                                   seed=self._algo_config.seed)
+        cfg = self._algo_config
+        if getattr(cfg, "prioritized_replay", False):
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.replay_buffer_capacity,
+                alpha=getattr(cfg, "prioritized_replay_alpha", 0.6),
+                beta=getattr(cfg, "prioritized_replay_beta", 0.4),
+                seed=cfg.seed,
+            )
+        else:
+            self.buffer = ReplayBuffer(cfg.replay_buffer_capacity,
+                                       seed=cfg.seed)
         self._since_target_sync = 0
 
     def training_step(self) -> Dict:
         cfg = self.config
+        n_step = int(getattr(cfg, "n_step", 1))
         self._sync_weights()
         for frag in self._sample_all():
             self._timesteps += frag.count
-            self.buffer.add(frag)
+            self.buffer.add(n_step_transform(frag, n_step, cfg.gamma))
         if len(self.buffer) < cfg.num_steps_sampled_before_learning:
             return {"buffer_size": len(self.buffer)}
         metrics = {}
         for _ in range(cfg.num_epochs):
             batch = self.buffer.sample(cfg.minibatch_size)
             metrics = self.learner.update(batch)
+            if "batch_indexes" in batch and hasattr(
+                self.buffer, "update_priorities"
+            ):
+                self.buffer.update_priorities(
+                    batch["batch_indexes"], self.learner.last_td_abs
+                )
             self._since_target_sync += 1
             if self._since_target_sync >= max(
                 1, cfg.target_network_update_freq // cfg.minibatch_size
@@ -807,6 +834,14 @@ class DQNConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(DQN)
         self.lr = 1e-3
+        # rainbow-family knobs (ray parity: rllib/algorithms/dqn/dqn.py
+        # DQNConfig — double_q/dueling/n_step/prioritized replay)
+        self.double_q = True
+        self.dueling = True
+        self.n_step = 1
+        self.prioritized_replay = True
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
 
 
 class SACConfig(AlgorithmConfig):

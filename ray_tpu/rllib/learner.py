@@ -383,10 +383,17 @@ class APPOLearner(Learner):
 
 
 class DQNLearner(Learner):
+    """DQN with the reference's rainbow-family knobs (ray parity:
+    rllib/algorithms/dqn — ``double_q``, ``dueling`` (module-side),
+    ``n_step`` (buffer-side; consumed here via ``nstep_discount``), and
+    prioritized replay (``weights`` importance correction in the loss +
+    per-sample |TD| returned for priority updates))."""
+
     def __init__(self, module: RLModule, config):
         super().__init__(module, config)
         net = module.net
         gamma = config.gamma
+        double_q = bool(getattr(config, "double_q", False))
         self.target_params = jax.tree.map(jnp.copy, module.params)
 
         def loss_fn(params, target_params, mb):
@@ -394,28 +401,53 @@ class DQNLearner(Learner):
             q_sel = jnp.take_along_axis(
                 q, mb[sb.ACTIONS][:, None].astype(jnp.int32), axis=1
             )[:, 0]
-            q_next, _ = net.apply({"params": target_params}, mb[sb.NEXT_OBS])
-            target = mb[sb.REWARDS] + gamma * (
+            q_next_t, _ = net.apply({"params": target_params},
+                                    mb[sb.NEXT_OBS])
+            if double_q:
+                # action selection by the ONLINE net, evaluation by the
+                # target net (van Hasselt 2016) — kills the max-operator
+                # overestimation bias
+                q_next_o, _ = net.apply({"params": params}, mb[sb.NEXT_OBS])
+                a_star = jnp.argmax(
+                    jax.lax.stop_gradient(q_next_o), axis=-1
+                )
+                q_boot = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=1
+                )[:, 0]
+            else:
+                q_boot = q_next_t.max(axis=-1)
+            # n-step fragments carry their actual bootstrap discount
+            # (gamma^h, horizon-clipped at episode ends)
+            disc = mb.get("nstep_discount", gamma)
+            target = mb[sb.REWARDS] + disc * (
                 1.0 - mb[sb.DONES].astype(jnp.float32)
-            ) * q_next.max(axis=-1)
+            ) * q_boot
             td = q_sel - jax.lax.stop_gradient(target)
-            return (td**2).mean(), jnp.abs(td).mean()
+            w = mb.get("weights")  # PER importance correction
+            loss = ((w * td**2).mean() if w is not None else (td**2).mean())
+            return loss, jnp.abs(td)
 
         def train_step(params, target_params, opt_state, mb):
-            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_params, mb
-            )
+            (loss, td_abs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, mb)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, {"loss": loss, "mean_td_error": td}
+            return params, opt_state, td_abs, {
+                "loss": loss, "mean_td_error": td_abs.mean(),
+            }
 
         self._train_step = jax.jit(train_step)
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
-        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
-        self.module.params, self.opt_state, metrics = self._train_step(
-            self.module.params, self.target_params, self.opt_state, jmb
-        )
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()
+               if k != "batch_indexes"}
+        self.module.params, self.opt_state, td_abs, metrics = \
+            self._train_step(
+                self.module.params, self.target_params, self.opt_state, jmb
+            )
+        # exposed for the algorithm's PER priority refresh
+        self.last_td_abs = np.asarray(td_abs)
         return {k: float(v) for k, v in metrics.items()}
 
     def sync_target(self):

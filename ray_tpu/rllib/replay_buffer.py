@@ -7,6 +7,7 @@ from typing import Optional
 
 import numpy as np
 
+from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -37,6 +38,55 @@ class ReplayBuffer:
     def sample(self, num_items: int) -> SampleBatch:
         idx = self.rng.integers(0, self._size, size=num_items)
         return SampleBatch({k: v[idx] for k, v in self._storage.items()})
+
+
+def n_step_transform(batch: SampleBatch, n_step: int,
+                     gamma: float) -> SampleBatch:
+    """Rewrite a rollout fragment into n-step transitions (ray parity: the
+    ``n_step`` knob of rllib/algorithms/dqn — applied before the replay
+    buffer, so stored transitions carry aggregated rewards).
+
+    For each t: reward := sum_{k<h} gamma^k r_{t+k}, next_obs := obs after
+    the horizon, where the horizon h stops early at episode boundaries.
+    Terminations keep done=True (no bootstrap); truncations stop the
+    window but leave done=False (bootstrap from the truncated state's
+    next_obs). Adds ``nstep_discount`` = gamma^h, the per-sample bootstrap
+    discount the TD target must use in place of a flat gamma."""
+    if n_step <= 1:
+        return batch
+    n = batch.count
+    rewards = np.asarray(batch[sb.REWARDS], np.float32)
+    dones = np.asarray(batch[sb.DONES], bool)
+    trunc = np.asarray(
+        batch.get(sb.TRUNCATEDS, np.zeros(n, bool)), bool
+    )
+    next_obs = np.asarray(batch[sb.NEXT_OBS])
+    out_r = np.zeros(n, np.float32)
+    out_done = np.zeros(n, bool)
+    out_next = next_obs.copy()
+    out_disc = np.zeros(n, np.float32)
+    for t in range(n):
+        acc, g = 0.0, 1.0
+        h = t
+        for k in range(n_step):
+            idx = t + k
+            if idx >= n:
+                break
+            acc += g * rewards[idx]
+            g *= gamma
+            h = idx
+            if dones[idx] or trunc[idx]:
+                break
+        out_r[t] = acc
+        out_done[t] = bool(dones[h])
+        out_next[t] = next_obs[h]
+        out_disc[t] = g  # gamma^h_actual
+    data = {k: v for k, v in batch.items()}
+    data[sb.REWARDS] = out_r
+    data[sb.DONES] = out_done
+    data[sb.NEXT_OBS] = out_next
+    data["nstep_discount"] = out_disc
+    return SampleBatch(data)
 
 
 class PrioritizedReplayBuffer(ReplayBuffer):

@@ -32,6 +32,29 @@ class DiscreteActorCritic(nn.Module):
         return logits, value
 
 
+class DuelingQNet(nn.Module):
+    """Dueling-architecture Q network (Wang et al. 2016; ray parity: the
+    ``dueling`` flag of rllib/algorithms/dqn): shared torso feeding a
+    state-value stream and an advantage stream, combined as
+    Q = V + A - mean(A). Returns ``(q_values, state_value)`` so it is a
+    drop-in for DiscreteActorCritic's ``(logits, value)`` contract —
+    samplers treat Q-values as logits (softmax exploration) and argmax
+    greedy works unchanged."""
+
+    num_actions: int
+    hiddens: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.tanh(nn.Dense(h, name=f"fc_{i}")(x))
+        adv = nn.Dense(self.num_actions, name="adv")(x)
+        val = nn.Dense(1, name="val")(x)[..., 0]
+        q = val[..., None] + adv - adv.mean(axis=-1, keepdims=True)
+        return q, val
+
+
 class ContinuousActor(nn.Module):
     """Deterministic policy: MLP -> tanh, rescaled into [low, high]
     (ray parity: DDPG/TD3 actor nets in rllib/algorithms/ddpg|td3)."""
@@ -118,8 +141,12 @@ class RLModule:
     """Bundles a flax module + param pytree with jitted inference ops."""
 
     def __init__(self, obs_shape: tuple, num_actions: int,
-                 hiddens: Sequence[int] = (64, 64), seed: int = 0):
-        self.net = DiscreteActorCritic(num_actions, tuple(hiddens))
+                 hiddens: Sequence[int] = (64, 64), seed: int = 0,
+                 dueling: bool = False):
+        if dueling:
+            self.net = DuelingQNet(num_actions, tuple(hiddens))
+        else:
+            self.net = DiscreteActorCritic(num_actions, tuple(hiddens))
         self.obs_shape = obs_shape
         self.num_actions = num_actions
         dummy = jnp.zeros((1, *obs_shape), jnp.float32)
