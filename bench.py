@@ -153,10 +153,13 @@ def main():
         # recomputes them blockwise and never materializes the matrix;
         # (c) full-block remat measured 0.555x (FLOP overhead): fallback
         # only.
+        # config #1 is the LANDER: smallest compile surface (no Pallas
+        # custom-vjp) at a batch size that cannot OOM — its only job is to
+        # guarantee a nonzero record before the budget can run out.
         sweep = [
-            (16, False, "flash", 8), (32, False, "flash", 8),
-            (64, False, "flash", 8), (16, False, "auto", 8),
-            (64, True, "flash", 8), (8, False, "auto", 0),
+            (16, False, "auto", 8), (16, False, "flash", 8),
+            (32, False, "flash", 8), (64, False, "flash", 8),
+            (64, True, "flash", 8),
         ]
     else:  # CPU smoke fallback so the bench always emits a line
         seq_len, steps, warmup = 128, 3, 1

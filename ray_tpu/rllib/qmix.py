@@ -1,0 +1,481 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Reference parity: ray rllib/algorithms/qmix (Rashid et al. 2018). Each
+agent runs a shared Q network over (obs, agent-id); a hypernetwork mixer
+conditioned on the GLOBAL state combines per-agent chosen-action Qs into
+Q_tot with non-negative mixing weights, so argmax decentralization is
+consistent with the centralized TD target (Individual-Global-Max).
+
+TPU-native: agent net + mixer + targets are one jitted train step; the
+mixer's batched matmuls ride the MXU. Rollouts run on CPU env-runner
+actors like every other algorithm here.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.multi_agent import MultiAgentEnv
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class TwoStepCoopGame(MultiAgentEnv):
+    """The two-step cooperative matrix game from the QMIX paper (§6.1):
+    agent 0's first action selects a branch; the second step pays a team
+    reward from that branch's payoff matrix. Branch B's optimum (8)
+    requires BOTH agents to coordinate on action 1, while its safe play
+    pays less than branch A's flat 7 — exactly the structure where
+    per-agent (VDN-style additive) values pick the wrong branch and a
+    state-conditioned monotonic mixer is needed."""
+
+    PAYOFF_B = np.array([[0.0, 1.0], [1.0, 8.0]], np.float32)
+
+    def __init__(self, env_config: Optional[dict] = None):
+        self.agent_ids = ["agent_0", "agent_1"]
+        self.observation_shape = (3,)  # one-hot of {start, branchA, branchB}
+        self.num_actions = 2
+        self.state_dim = 3
+        self._state = 0
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        o[self._state] = 1.0
+        return {aid: o.copy() for aid in self.agent_ids}
+
+    def state(self) -> np.ndarray:
+        s = np.zeros(3, np.float32)
+        s[self._state] = 1.0
+        return s
+
+    def reset(self, *, seed=None, options=None):
+        self._state = 0
+        return self._obs(), {}
+
+    def step(self, action_dict: Dict[str, Any]):
+        if self._state == 0:
+            self._state = 1 if int(action_dict["agent_0"]) == 0 else 2
+            obs = self._obs()
+            return (obs, {a: 0.0 for a in self.agent_ids},
+                    {"__all__": False}, {"__all__": False}, {})
+        if self._state == 1:
+            r = 7.0
+        else:
+            r = float(self.PAYOFF_B[int(action_dict["agent_0"]),
+                                    int(action_dict["agent_1"])])
+        self._state = 0
+        obs = self._obs()
+        rew = {a: r for a in self.agent_ids}
+        return obs, rew, {"__all__": True}, {"__all__": False}, {}
+
+
+from ray_tpu.rllib.env import register_env  # noqa: E402
+
+register_env("TwoStepCoop", lambda cfg: TwoStepCoopGame(cfg))
+
+
+class AgentQNet(nn.Module):
+    """Shared per-agent Q network over (obs ++ one-hot agent id)."""
+
+    num_actions: int
+    hiddens: tuple = (64,)
+
+    @nn.compact
+    def __call__(self, x):
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.Dense(self.num_actions, name="q")(x)
+
+
+class QMixer(nn.Module):
+    """Monotonic mixing hypernetwork: Q_tot(s, q_1..q_n) with
+    dQ_tot/dq_a >= 0 enforced by abs() on the generated weights."""
+
+    n_agents: int
+    embed_dim: int = 32
+
+    @nn.compact
+    def __call__(self, agent_qs, state):
+        B = agent_qs.shape[0]
+        w1 = jnp.abs(
+            nn.Dense(self.n_agents * self.embed_dim, name="hyper_w1")(state)
+        ).reshape(B, self.n_agents, self.embed_dim)
+        b1 = nn.Dense(self.embed_dim, name="hyper_b1")(state)
+        h = nn.elu(
+            jnp.einsum("bn,bne->be", agent_qs, w1) + b1
+        )
+        w2 = jnp.abs(
+            nn.Dense(self.embed_dim, name="hyper_w2")(state)
+        )
+        b2 = nn.Dense(1, name="hyper_b2_out")(
+            nn.relu(nn.Dense(self.embed_dim, name="hyper_b2_hid")(state))
+        )[..., 0]
+        return jnp.einsum("be,be->b", h, w2) + b2
+
+
+class QMixModule:
+    """Agent net + mixer params with jitted inference/greedy ops."""
+
+    def __init__(self, obs_dim: int, n_agents: int, num_actions: int,
+                 state_dim: int, hiddens: tuple = (64,),
+                 embed_dim: int = 32, seed: int = 0):
+        self.n_agents = n_agents
+        self.num_actions = num_actions
+        self.agent_net = AgentQNet(num_actions, tuple(hiddens))
+        self.mixer = QMixer(n_agents, embed_dim)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        in_dim = obs_dim + n_agents
+        self.params = {
+            "agent": self.agent_net.init(
+                k1, jnp.zeros((1, in_dim), jnp.float32))["params"],
+            "mixer": self.mixer.init(
+                k2, jnp.zeros((1, n_agents), jnp.float32),
+                jnp.zeros((1, state_dim), jnp.float32))["params"],
+        }
+
+        def per_agent_q(params, obs_id):
+            # obs_id: [B, n_agents, obs_dim + n_agents]
+            B, n, d = obs_id.shape
+            q = self.agent_net.apply(
+                {"params": params["agent"]}, obs_id.reshape(B * n, d)
+            )
+            return q.reshape(B, n, self.num_actions)
+
+        self.per_agent_q = jax.jit(per_agent_q)
+
+        def greedy(params, obs_id):
+            return jnp.argmax(per_agent_q(params, obs_id), axis=-1)
+
+        self._greedy = jax.jit(greedy)
+
+    def actions_greedy(self, obs_id: np.ndarray) -> np.ndarray:
+        return np.asarray(self._greedy(self.params, obs_id))
+
+    def get_state(self):
+        return jax.device_get(self.params)
+
+    def set_state(self, params):
+        self.params = jax.device_put(params)
+
+
+def _stack_obs(obs: Dict[str, np.ndarray], agent_ids: List[str]) -> np.ndarray:
+    """[n_agents, obs_dim + n_agents]: per-agent obs ++ one-hot agent id
+    (the shared-net convention; ray parity: QMIX agent grouping)."""
+    n = len(agent_ids)
+    rows = []
+    for i, aid in enumerate(agent_ids):
+        onehot = np.zeros(n, np.float32)
+        onehot[i] = 1.0
+        rows.append(np.concatenate([np.asarray(obs[aid], np.float32), onehot]))
+    return np.stack(rows)
+
+
+class QMixEnvRunner:
+    """Joint-transition collector: steps ALL agents with epsilon-greedy
+    actions from the shared net, records (obs, state, actions, team
+    reward, done) tuples."""
+
+    def __init__(self, env_spec, env_config, module_kwargs: Dict,
+                 seed: int = 0):
+        from ray_tpu.rllib.env import make_env
+
+        self.env = make_env(env_spec, env_config)
+        self.agent_ids = list(self.env.agent_ids)
+        self.module = QMixModule(
+            obs_dim=int(np.prod(self.env.observation_shape)),
+            n_agents=len(self.agent_ids),
+            num_actions=self.env.num_actions,
+            state_dim=getattr(self.env, "state_dim",
+                              int(np.prod(self.env.observation_shape))
+                              * len(self.agent_ids)),
+            **module_kwargs,
+        )
+        self.rng = np.random.default_rng(seed)
+        self._obs = None
+        self._last_obs: Dict[str, np.ndarray] = {}
+        self._ep_return = 0.0
+        self._returns: List[float] = []
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+
+    def ping(self):
+        return "pong"
+
+    def evaluate(self, num_episodes: int = 5):
+        returns = []
+        for _ in range(num_episodes):
+            obs, _ = self.env.reset()
+            self._last_obs = dict(obs)
+            total, done = 0.0, False
+            while not done:
+                stacked = _stack_obs(self._last_obs, self.agent_ids)
+                a = self.module.actions_greedy(stacked[None])[0]
+                acts = {aid: int(a[i])
+                        for i, aid in enumerate(self.agent_ids)}
+                nobs, rew, term, trunc, _ = self.env.step(acts)
+                self._last_obs.update(nobs)
+                total += float(sum(rew.values())) / max(1, len(rew))
+                done = bool(term.get("__all__")) or bool(trunc.get("__all__"))
+            returns.append(total)
+        self._obs = None  # force fresh reset for the next sample()
+        return {"evaluation/episode_return_mean": float(np.mean(returns))}
+
+    def _state_vec(self) -> np.ndarray:
+        if hasattr(self.env, "state"):
+            return np.asarray(self.env.state(), np.float32)
+        return np.concatenate(
+            [np.asarray(self._last_obs[a], np.float32)
+             for a in self.agent_ids]
+        )
+
+    def sample(self, num_steps: int, epsilon: float) -> SampleBatch:
+        if self._obs is None:
+            self._obs, _ = self.env.reset()
+            self._last_obs = dict(self._obs)
+            self._ep_return = 0.0
+        cols: Dict[str, list] = {k: [] for k in (
+            "obs", "next_obs", "state", "next_state", "actions", "rewards",
+            "dones",
+        )}
+        for _ in range(num_steps):
+            stacked = _stack_obs(self._last_obs, self.agent_ids)
+            state = self._state_vec()
+            greedy = self.module.actions_greedy(stacked[None])[0]
+            acts = {}
+            for i, aid in enumerate(self.agent_ids):
+                if self.rng.random() < epsilon:
+                    acts[aid] = int(self.rng.integers(self.env.num_actions))
+                else:
+                    acts[aid] = int(greedy[i])
+            nobs, rew, term, trunc, _ = self.env.step(acts)
+            # done agents drop out of the env's dicts; keep their last obs
+            # so the joint stack stays well-defined until "__all__"
+            self._last_obs.update(nobs)
+            terminated = bool(term.get("__all__"))
+            episode_over = terminated or bool(trunc.get("__all__"))
+            team_r = float(sum(rew.values())) / max(1, len(rew))
+            self._ep_return += team_r
+            cols["obs"].append(stacked)
+            cols["next_obs"].append(_stack_obs(self._last_obs, self.agent_ids))
+            cols["state"].append(state)
+            cols["next_state"].append(self._state_vec())
+            cols["actions"].append(
+                np.asarray([acts[a] for a in self.agent_ids], np.int32)
+            )
+            cols["rewards"].append(team_r)
+            # sb.DONES contract: terminated ONLY — a time-limit truncation
+            # must keep the TD bootstrap alive
+            cols["dones"].append(terminated)
+            if episode_over:
+                self._returns.append(self._ep_return)
+                self._obs, _ = self.env.reset()
+                self._last_obs = dict(self._obs)
+                self._ep_return = 0.0
+        return SampleBatch({
+            k: np.asarray(v) for k, v in cols.items()
+        })
+
+    def get_metrics(self) -> Dict[str, float]:
+        out = {
+            "episodes_this_iter": len(self._returns),
+            "episode_return_mean": float(np.mean(self._returns))
+            if self._returns else float("nan"),
+        }
+        self._returns = []
+        return out
+
+
+class QMixLearner:
+    """Centralized TD on Q_tot with target agent net + target mixer."""
+
+    def __init__(self, module: QMixModule, config):
+        self.module = module
+        self.config = config
+        gamma = config.gamma
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(getattr(config, "grad_clip", 10.0)),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.tx.init(module.params)
+        self.target_params = jax.tree.map(jnp.copy, module.params)
+        per_agent_q = module.per_agent_q
+        mixer = module.mixer
+
+        def loss_fn(params, target_params, mb):
+            q_all = per_agent_q(params, mb["obs"])  # [B, n, A]
+            q_sel = jnp.take_along_axis(
+                q_all, mb["actions"][..., None].astype(jnp.int32), axis=-1
+            )[..., 0]  # [B, n]
+            q_tot = mixer.apply(
+                {"params": params["mixer"]}, q_sel, mb["state"]
+            )
+            # double-Q at the team level: online argmax, target evaluation
+            q_next_online = per_agent_q(params, mb["next_obs"])
+            a_star = jnp.argmax(jax.lax.stop_gradient(q_next_online), -1)
+            q_next_target = per_agent_q(target_params, mb["next_obs"])
+            q_next_sel = jnp.take_along_axis(
+                q_next_target, a_star[..., None], axis=-1
+            )[..., 0]
+            target_tot = mixer.apply(
+                {"params": target_params["mixer"]}, q_next_sel,
+                mb["next_state"],
+            )
+            y = mb["rewards"] + gamma * (
+                1.0 - mb["dones"].astype(jnp.float32)
+            ) * target_tot
+            td = q_tot - jax.lax.stop_gradient(y)
+            return (td ** 2).mean(), jnp.abs(td).mean()
+
+        def train_step(params, target_params, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, mb
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "mean_td_error": td}
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jmb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.module.params, self.opt_state, metrics = self._train_step(
+            self.module.params, self.target_params, self.opt_state, jmb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self):
+        self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
+    # weight protocol used by checkpointing + runner-FT re-push
+    # (Algorithm.save_checkpoint / _restore_dead_runners)
+    def get_weights(self):
+        return self.module.get_state()
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+
+    def get_optimizer_state(self):
+        return {"opt": self.opt_state, "target_params": self.target_params}
+
+    def set_optimizer_state(self, state):
+        if state is None:
+            self.opt_state = self.tx.init(self.module.params)
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+        else:
+            self.opt_state = state["opt"]
+            self.target_params = state["target_params"]
+
+
+class QMIX(Algorithm):
+    _learner_cls = QMixLearner
+
+    def setup(self, _config):
+        from ray_tpu.rllib.env import make_env
+
+        cfg = self._algo_config
+        if getattr(cfg, "num_learners", 0) >= 1:
+            raise ValueError("num_learners>=1 is not supported for QMIX")
+        probe = make_env(cfg.env, cfg.env_config)
+        agent_ids = list(probe.agent_ids)
+        obs_dim = int(np.prod(probe.observation_shape))
+        state_dim = getattr(probe, "state_dim", obs_dim * len(agent_ids))
+        num_actions = probe.num_actions
+        if hasattr(probe, "close"):
+            probe.close()
+        module_kwargs = {
+            "hiddens": tuple(cfg.model.get("hiddens", (64,))),
+            "embed_dim": getattr(cfg, "mixing_embed_dim", 32),
+            "seed": cfg.seed,
+        }
+        self.module = QMixModule(
+            obs_dim, len(agent_ids), num_actions, state_dim, **module_kwargs
+        )
+        self.learner = QMixLearner(self.module, cfg)
+        runner_cls = ray_tpu.remote(
+            num_cpus=0.5, max_restarts=2, max_task_retries=2,
+            runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
+        )(QMixEnvRunner)
+        self._runner_factory = lambda i, replacement=False: runner_cls.remote(
+            cfg.env, cfg.env_config, module_kwargs, seed=cfg.seed + i,
+        )
+        self.runners = [
+            self._runner_factory(i) for i in range(cfg.num_env_runners)
+        ]
+        self.eval_runners = []
+        self.agent_ids = agent_ids
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._timesteps = 0
+        self._since_target_sync = 0
+
+    def _epsilon(self) -> float:
+        start, end, decay = self.config.epsilon
+        frac = min(1.0, self._timesteps / max(1, decay))
+        return float(start + (end - start) * frac)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        eps = self._epsilon()
+        frags = self._with_runner_ft(lambda: ray_tpu.get([
+            r.sample.remote(cfg.rollout_fragment_length, eps)
+            for r in self.runners
+        ]))
+        for frag in frags:
+            self._timesteps += frag.count
+            self.buffer.add(frag)
+        if len(self.buffer) < cfg.num_steps_sampled_before_learning:
+            return {"buffer_size": len(self.buffer), "epsilon": eps}
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            metrics = self.learner.update(
+                self.buffer.sample(cfg.minibatch_size)
+            )
+            self._since_target_sync += 1
+            if self._since_target_sync >= max(
+                1, cfg.target_network_update_freq // cfg.minibatch_size
+            ):
+                self.learner.sync_target()
+                self._since_target_sync = 0
+        metrics["buffer_size"] = len(self.buffer)
+        metrics["epsilon"] = eps
+        return metrics
+
+    def _sync_weights(self):
+        params = self.module.get_state()
+        self._with_runner_ft(lambda: ray_tpu.get([
+            r.set_weights.remote(params) for r in self.runners
+        ]))
+
+    def compute_actions(self, obs: Dict[str, np.ndarray]) -> Dict[str, int]:
+        """Greedy joint action for one env step (decentralized
+        execution). Agents are ordered exactly as during training
+        (env.agent_ids) — sorting obs keys would permute the one-hot
+        agent IDs once ids reach double digits."""
+        ids = [a for a in self.agent_ids if a in obs]
+        stacked = _stack_obs(obs, ids)
+        a = self.module.actions_greedy(stacked[None])[0]
+        return {aid: int(a[i]) for i, aid in enumerate(ids)}
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(QMIX)
+        self.lr = 5e-4
+        self.mixing_embed_dim = 32
+        self.model = {"hiddens": (64,)}
+        self.epsilon = (1.0, 0.05, 2_000)
+        self.replay_buffer_capacity = 20_000
+        self.target_network_update_freq = 200
+        self.num_steps_sampled_before_learning = 200
+        self.minibatch_size = 64
+        self.num_epochs = 4

@@ -1,0 +1,81 @@
+"""QMIX: monotonic value-mixing MARL (ray parity: rllib/algorithms/qmix),
+validated on the paper's two-step coordination game — the canonical case
+where per-agent greedy values pick the wrong branch without a
+state-conditioned mixer."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import QMIXConfig, TwoStepCoopGame
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_two_step_game_payoffs():
+    env = TwoStepCoopGame({})
+    obs, _ = env.reset()
+    assert set(obs) == {"agent_0", "agent_1"}
+    # branch B + joint (1,1) pays the optimum 8
+    env.reset()
+    env.step({"agent_0": 1, "agent_1": 0})
+    _, rew, term, _, _ = env.step({"agent_0": 1, "agent_1": 1})
+    assert rew["agent_0"] == 8.0 and term["__all__"]
+    # branch A pays a flat 7
+    env.reset()
+    env.step({"agent_0": 0, "agent_1": 0})
+    _, rew, _, _, _ = env.step({"agent_0": 0, "agent_1": 1})
+    assert rew["agent_0"] == 7.0
+
+
+def test_mixer_monotonic_in_agent_qs():
+    from ray_tpu.rllib.qmix import QMixModule
+
+    m = QMixModule(obs_dim=3, n_agents=2, num_actions=2, state_dim=3, seed=0)
+    state = np.eye(3, dtype=np.float32)[:1]
+    base = np.array([[1.0, 1.0]], np.float32)
+    import jax.numpy as jnp
+
+    q0 = m.mixer.apply({"params": m.params["mixer"]},
+                       jnp.asarray(base), jnp.asarray(state))
+    for i in range(2):
+        bumped = base.copy()
+        bumped[0, i] += 1.0
+        qi = m.mixer.apply({"params": m.params["mixer"]},
+                           jnp.asarray(bumped), jnp.asarray(state))
+        assert float(qi[0]) >= float(q0[0]) - 1e-6  # dQtot/dq_a >= 0
+
+
+def test_qmix_solves_two_step_game(ray_cluster):
+    cfg = (
+        QMIXConfig()
+        .environment(TwoStepCoopGame)
+        .env_runners(num_env_runners=1, rollout_fragment_length=64)
+        .training(lr=5e-3, minibatch_size=64, num_epochs=8,
+                  num_steps_sampled_before_learning=128,
+                  target_network_update_freq=128)
+        .debugging(seed=3)
+    )
+    algo = cfg.build()
+    try:
+        solved = False
+        for _ in range(40):
+            algo.train()
+            # greedy rollout: must pick branch B then coordinate on (1,1)
+            env = TwoStepCoopGame({})
+            obs, _ = env.reset()
+            acts = algo.compute_actions(obs)
+            obs, _, _, _, _ = env.step(acts)
+            acts2 = algo.compute_actions(obs)
+            _, rew, _, _, _ = env.step(acts2)
+            if rew["agent_0"] == 8.0:
+                solved = True
+                break
+        assert solved, "QMIX failed to find the coordinated optimum (8)"
+    finally:
+        algo.stop()
