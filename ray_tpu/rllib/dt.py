@@ -1,0 +1,333 @@
+"""Decision Transformer: offline RL as return-conditioned sequence
+modeling (Chen et al. 2021; ray parity: rllib/algorithms/dt).
+
+The policy is a small causal transformer over interleaved
+(return-to-go, state, action) token triples; training is supervised
+action prediction on offline episodes, and acting conditions the model
+on a TARGET return — ask for a high return and the model extrapolates
+the behavior that achieved high returns in the data. This is the
+MXU-native member of the offline family: the whole policy is matmuls
+under one jit (the same hardware profile as the model zoo, unlike the
+MLP-based BC/MARWIL/CQL).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import read_json_fragments
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DTNet(nn.Module):
+    """Causal transformer over (rtg, state, action) token triples.
+
+    Sequence layout per timestep t: [R_t, s_t, a_t] -> 3K tokens for a
+    K-step context. Action logits are read at the STATE positions (the
+    model has seen R_t and s_t, not yet a_t)."""
+
+    num_actions: int
+    obs_dim: int
+    d_model: int = 64
+    n_layer: int = 2
+    n_head: int = 2
+    max_timestep: int = 1024
+
+    @nn.compact
+    def __call__(self, rtg, obs, actions, timesteps):
+        # rtg: [B,K] float; obs: [B,K,obs_dim]; actions: [B,K] int32
+        # (teacher-forced, shifted internally); timesteps: [B,K] int32
+        B, K = rtg.shape
+        t_emb = nn.Embed(self.max_timestep, self.d_model,
+                         name="timestep_emb")(timesteps)
+        r_tok = nn.Dense(self.d_model, name="rtg_emb")(rtg[..., None]) + t_emb
+        s_tok = nn.Dense(self.d_model, name="obs_emb")(obs) + t_emb
+        a_tok = nn.Embed(self.num_actions + 1, self.d_model,
+                         name="act_emb")(actions + 1) + t_emb
+        # interleave to [B, 3K, H]: (R_1, s_1, a_1, R_2, s_2, a_2, ...)
+        x = jnp.stack([r_tok, s_tok, a_tok], axis=2).reshape(
+            B, 3 * K, self.d_model
+        )
+        for i in range(self.n_layer):
+            h = nn.LayerNorm(name=f"ln1_{i}")(x)
+            qkv = nn.Dense(3 * self.d_model, name=f"attn_qkv_{i}")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hd = self.d_model // self.n_head
+            shape = (B, 3 * K, self.n_head, hd)
+            att = jax.nn.dot_product_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                is_causal=True,
+            ).reshape(B, 3 * K, self.d_model)
+            x = x + nn.Dense(self.d_model, name=f"attn_proj_{i}")(att)
+            h = nn.LayerNorm(name=f"ln2_{i}")(x)
+            h = nn.gelu(nn.Dense(4 * self.d_model, name=f"mlp_up_{i}")(h))
+            x = x + nn.Dense(self.d_model, name=f"mlp_down_{i}")(h)
+        x = nn.LayerNorm(name="ln_f")(x)
+        state_positions = x.reshape(B, K, 3, self.d_model)[:, :, 1]
+        return nn.Dense(self.num_actions, name="head")(state_positions)
+
+
+class DTModule:
+    """Params + jitted forward for training and rolling-context acting."""
+
+    def __init__(self, obs_dim: int, num_actions: int, context_len: int,
+                 d_model: int = 64, n_layer: int = 2, n_head: int = 2,
+                 max_timestep: int = 1024, seed: int = 0):
+        self.context_len = context_len
+        self.num_actions = num_actions
+        self.obs_dim = obs_dim
+        self.net = DTNet(num_actions, obs_dim, d_model, n_layer, n_head,
+                         max_timestep)
+        K = context_len
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, K), jnp.float32),
+            jnp.zeros((1, K, obs_dim), jnp.float32),
+            jnp.zeros((1, K), jnp.int32),
+            jnp.zeros((1, K), jnp.int32),
+        )["params"]
+
+        def fwd(params, rtg, obs, actions, timesteps):
+            return self.net.apply({"params": params}, rtg, obs, actions,
+                                  timesteps)
+
+        self.forward = jax.jit(fwd)
+
+    def get_state(self):
+        return jax.device_get(self.params)
+
+    def set_state(self, params):
+        self.params = jax.device_put(params)
+
+
+def episodes_from_fragments(frags: List[SampleBatch]) -> List[Dict[str, np.ndarray]]:
+    """Split offline fragments at episode boundaries and precompute
+    undiscounted returns-to-go (the DT conditioning signal).
+
+    Fragments are processed INDEPENDENTLY — datasets recorded by parallel
+    runners interleave fragments, so trajectory state must never cross a
+    seam (read_json_fragments documents the same invariant). A fragment's
+    unterminated tail is DROPPED: its remaining rewards live in some
+    other fragment, so its return-to-go cannot be computed correctly."""
+    episodes = []
+    for frag in frags:
+        dones = np.asarray(
+            frag.get(sb.DONES, np.zeros(frag.count, bool))
+        ).astype(bool)
+        trunc = np.asarray(
+            frag.get(sb.TRUNCATEDS, np.zeros(frag.count, bool))
+        ).astype(bool)
+        cur: Dict[str, list] = {"obs": [], "actions": [], "rewards": []}
+        for i in range(frag.count):
+            cur["obs"].append(np.asarray(frag[sb.OBS][i], np.float32))
+            cur["actions"].append(int(frag[sb.ACTIONS][i]))
+            cur["rewards"].append(float(frag[sb.REWARDS][i]))
+            if dones[i] or trunc[i]:
+                episodes.append(_finish_episode(cur))
+                cur = {"obs": [], "actions": [], "rewards": []}
+    return episodes
+
+
+def _finish_episode(cur: Dict[str, list]) -> Dict[str, np.ndarray]:
+    rewards = np.asarray(cur["rewards"], np.float32)
+    rtg = np.cumsum(rewards[::-1])[::-1].copy()
+    return {
+        "obs": np.stack(cur["obs"]),
+        "actions": np.asarray(cur["actions"], np.int32),
+        "rtg": rtg,
+        "timesteps": np.arange(len(rewards), dtype=np.int32),
+    }
+
+
+class DTLearner:
+    """Supervised next-action prediction over offline context windows."""
+
+    def __init__(self, module: DTModule, config):
+        self.module = module
+        self.config = config
+        self.tx = optax.adamw(config.lr, weight_decay=1e-4)
+        self.opt_state = self.tx.init(module.params)
+        net = module.net
+
+        def loss_fn(params, mb):
+            # actions feed in UNSHIFTED: the causal mask already hides
+            # a_t's token (position 3t+2) from the state position 3t+1
+            # where a_t is predicted, while a_{t-1} stays visible — the
+            # reference DT's layout
+            logits = net.apply(
+                {"params": params}, mb["rtg"], mb["obs"], mb["actions"],
+                mb["timesteps"],
+            )
+            logp = jax.nn.log_softmax(logits)
+            ll = jnp.take_along_axis(
+                logp, mb["actions"][..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            mask = mb["mask"]
+            loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            acc = ((jnp.argmax(logits, -1) == mb["actions"]) * mask).sum() \
+                / jnp.maximum(mask.sum(), 1.0)
+            return loss, acc
+
+        def train_step(params, opt_state, mb):
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "action_accuracy": acc}
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, mb: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jmb = {k: jnp.asarray(v) for k, v in mb.items()}
+        self.module.params, self.opt_state, metrics = self._train_step(
+            self.module.params, self.opt_state, jmb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self.module.get_state()
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+
+    def get_optimizer_state(self):
+        return self.opt_state
+
+    def set_optimizer_state(self, state):
+        self.opt_state = state if state is not None \
+            else self.tx.init(self.module.params)
+
+
+class DT(Algorithm):
+    """Offline algorithm: no env runners; training_step samples context
+    windows uniformly from the offline episodes."""
+
+    _learner_cls = DTLearner
+
+    def setup(self, _config):
+        cfg = self._algo_config
+        input_ = getattr(cfg, "input_", None)
+        if not input_:
+            raise ValueError("DTConfig.offline_data(input_=...) is required")
+        self._episodes = episodes_from_fragments(read_json_fragments(input_))
+        if not self._episodes:
+            raise ValueError(f"no episodes found in {input_!r}")
+        obs_dim = int(self._episodes[0]["obs"].shape[-1])
+        num_actions = int(
+            max(int(ep["actions"].max()) for ep in self._episodes) + 1
+        )
+        K = cfg.context_len
+        self.module = DTModule(
+            obs_dim, num_actions, K,
+            d_model=cfg.model.get("d_model", 64),
+            n_layer=cfg.model.get("n_layer", 2),
+            n_head=cfg.model.get("n_head", 2),
+            max_timestep=cfg.max_timestep, seed=cfg.seed,
+        )
+        self.learner = DTLearner(self.module, cfg)
+        self.runners = []
+        self.eval_runners = []
+        self.rng = np.random.default_rng(cfg.seed)
+        self._timesteps = 0
+
+    def _sample_windows(self, batch_size: int) -> Dict[str, np.ndarray]:
+        K = self.config.context_len
+        obs_dim = self.module.obs_dim
+        out = {
+            "rtg": np.zeros((batch_size, K), np.float32),
+            "obs": np.zeros((batch_size, K, obs_dim), np.float32),
+            "actions": np.zeros((batch_size, K), np.int32),
+            "timesteps": np.zeros((batch_size, K), np.int32),
+            "mask": np.zeros((batch_size, K), np.float32),
+        }
+        for b in range(batch_size):
+            ep = self._episodes[self.rng.integers(len(self._episodes))]
+            T = len(ep["actions"])
+            start = int(self.rng.integers(T))
+            end = min(T, start + K)
+            n = end - start
+            out["rtg"][b, :n] = ep["rtg"][start:end]
+            out["obs"][b, :n] = ep["obs"][start:end]
+            out["actions"][b, :n] = ep["actions"][start:end]
+            out["timesteps"][b, :n] = ep["timesteps"][start:end]
+            out["mask"][b, :n] = 1.0
+        return out
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            mb = self._sample_windows(cfg.minibatch_size)
+            metrics = self.learner.update(mb)
+            self._timesteps += cfg.minibatch_size
+        return metrics
+
+    def step(self) -> Dict:
+        metrics = self.training_step()
+        self._train_iter = getattr(self, "_train_iter", 0) + 1
+        metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        return metrics
+
+    # -- acting --------------------------------------------------------
+    def start_episode(self, target_return: float):
+        """Begin a return-conditioned rollout; feed observations through
+        ``compute_single_action`` and rewards through ``observe_reward``."""
+        self._ctx = {
+            "rtg": [float(target_return)], "obs": [], "actions": [],
+            "timesteps": [],
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        c = self._ctx
+        K = self.config.context_len
+        t = len(c["obs"])
+        c["obs"].append(np.asarray(obs, np.float32))
+        c["timesteps"].append(min(t, self.config.max_timestep - 1))
+        n = min(K, len(c["obs"]))
+        rtg = np.zeros((1, K), np.float32)
+        ob = np.zeros((1, K, self.module.obs_dim), np.float32)
+        # past actions in their own slots; the CURRENT step's action slot
+        # holds the -1 pad — causality makes its content unreadable at the
+        # state position being decoded anyway
+        act = np.full((1, K), -1, np.int32)
+        ts = np.zeros((1, K), np.int32)
+        rtg[0, :n] = c["rtg"][-n:]
+        ob[0, :n] = np.stack(c["obs"][-n:])
+        past = c["actions"][-(n - 1):] if n > 1 else []
+        act[0, :len(past)] = past
+        ts[0, :n] = c["timesteps"][-n:]
+        logits = self.module.forward(self.module.params, rtg, ob, act, ts)
+        a = int(np.argmax(np.asarray(logits)[0, n - 1]))
+        c["actions"].append(a)
+        return a
+
+    def observe_reward(self, reward: float):
+        c = self._ctx
+        c["rtg"].append(c["rtg"][-1] - float(reward))
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DT)
+        self.lr = 1e-3
+        self.context_len = 8
+        self.max_timestep = 1024
+        self.model = {"d_model": 64, "n_layer": 2, "n_head": 2}
+        self.minibatch_size = 64
+        self.num_epochs = 20
+        self.num_env_runners = 0
+        self.input_: Optional[str] = None
+
+    def offline_data(self, *, input_=None, **_kw):
+        if input_ is not None:
+            self.input_ = input_
+        return self
