@@ -22,13 +22,50 @@ from ray_tpu.serve._common import REPLICA_PUSH_CHANNEL, SERVE_CONTROLLER_NAME
 _REFRESH_PERIOD_S = 1.0
 
 
+_REPLICA_DEATH_PHRASES = (
+    # exact system-generated messages for a dead/vanished actor worker
+    # (raylet._send_task_failure / worker._fail_returns_exc); matched only
+    # ONE cause-level deep so an application error that merely EMBEDS an
+    # actor death from a downstream call (TaskError nested two deep, or a
+    # traceback string) is never retried — the replica itself is alive and
+    # re-executing its side-effecting handler would break at-most-once.
+    "actor worker died",
+    "worker died while executing",
+    "actor tasks run at-most-once",
+    "leased worker lost",
+)
+
+
+def _is_replica_death(exc: BaseException) -> bool:
+    """Did this call fail because its replica actor died (rolling update,
+    crash)? Those failures are retriable on ANOTHER replica — serve's
+    contract is that redeploys don't drop requests (ray parity: the
+    router's retry on RayActorError)."""
+    import ray_tpu
+    from ray_tpu._private.serialization import TaskError
+
+    if isinstance(exc, ray_tpu.ActorDiedError):
+        return True
+    if isinstance(exc, TaskError):
+        cause = exc.cause
+        if isinstance(cause, ray_tpu.ActorDiedError):
+            return True
+        if isinstance(cause, RuntimeError) and any(
+            p in (cause.args[0] if cause.args else "")
+            for p in _REPLICA_DEATH_PHRASES
+        ):
+            return True
+    return False
+
+
 class DeploymentResponse:
     """Future-like result of handle.remote() (ray parity:
     serve.handle.DeploymentResponse)."""
 
-    def __init__(self, ref, on_settle=None):
+    def __init__(self, ref, on_settle=None, resubmit=None):
         self._ref = ref
         self._on_settle = on_settle
+        self._resubmit = resubmit
         self._settled = False
 
     def _settle(self):
@@ -48,8 +85,28 @@ class DeploymentResponse:
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
 
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
         try:
             out = ray_tpu.get(self._ref, timeout=timeout_s)
+            # success: drop the retry closure — it pins the request
+            # payload (args/kwargs) for the response's lifetime otherwise
+            self._resubmit = None
+        except Exception as e:
+            self._settle()
+            # Replica died with this request in flight (rolling update):
+            # re-route to a live replica instead of surfacing the death —
+            # handler code is expected idempotent under serve's retry
+            # contract, exactly as in the reference. The caller's timeout
+            # budget is shared across retries, not restarted.
+            if self._resubmit is not None and _is_replica_death(e):
+                retry = self._resubmit()
+                if retry is not None:
+                    remaining = None if deadline is None else max(
+                        0.0, deadline - time.monotonic()
+                    )
+                    return retry.result(remaining)
+            raise
         finally:
             self._settle()
         from ray_tpu.serve.replica import STREAM_MARKER
@@ -304,6 +361,9 @@ class DeploymentHandle:
         self._state.refresh(force=force)
 
     def remote(self, *args, **kwargs):
+        return self._remote_attempt(args, kwargs, retries_left=3)
+
+    def _remote_attempt(self, args, kwargs, retries_left: int):
         st = self._state
         deadline = time.monotonic() + 30.0
         last_err = None
@@ -324,7 +384,21 @@ class DeploymentHandle:
 
                 if self._stream:
                     return DeploymentResponseGenerator(ref, on_settle=settle)
-                return DeploymentResponse(ref, on_settle=settle)
+
+                def resubmit(remaining=retries_left):
+                    # replica died mid-request: route again on a fresh
+                    # replica table (bounded — not every death is a
+                    # rolling update)
+                    if remaining <= 0:
+                        return None
+                    st.refresh(force=True)
+                    return self._remote_attempt(
+                        args, kwargs, retries_left=remaining - 1
+                    )
+
+                return DeploymentResponse(
+                    ref, on_settle=settle, resubmit=resubmit
+                )
             except Exception as e:
                 last_err = e
                 st.refresh(force=True)

@@ -4,7 +4,7 @@ from ray_tpu.tune.schedulers.async_hyperband import (
 )
 from ray_tpu.tune.schedulers.hyperband import HyperBandForBOHB, HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
-from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+from ray_tpu.tune.schedulers.pbt import PB2, PopulationBasedTraining
 from ray_tpu.tune.schedulers.trial_scheduler import FIFOScheduler, TrialScheduler
 
 __all__ = [
@@ -14,6 +14,7 @@ __all__ = [
     "HyperBandForBOHB",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "TrialScheduler",
 ]
