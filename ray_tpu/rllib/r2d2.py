@@ -1,0 +1,436 @@
+"""R2D2: recurrent-replay DQN (Kapturowski et al. 2019; ray parity:
+rllib/algorithms/r2d2).
+
+The Q network carries an LSTM, replay stores SEQUENCES instead of
+transitions, and the learner unrolls the recurrent state over each
+sequence (optional burn-in prefix excluded from the loss) with double-Q
+targets. This is the framework's recurrent-policy path: acting carries
+hidden state across env steps, so the policy can integrate information
+that is no longer observable — the capability the memory-task test
+isolates (a feedforward DQN is provably at chance there).
+
+TPU-native: the unroll is a single ``flax nn.scan`` over an LSTMCell
+inside one jitted train step — time-major scan, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env, register_env
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MemoryChainEnv:
+    """Memory probe: a cue shown ONLY at t=0 must be acted on at the
+    final step. Rewards: +1 for matching the cue at the end, 0 otherwise;
+    intermediate steps carry no reward and no cue. Expected return of any
+    memoryless policy: 0.5."""
+
+    def __init__(self, env_config: Optional[dict] = None):
+        cfg = env_config or {}
+        self.length = int(cfg.get("length", 5))
+        self.rng = np.random.default_rng(cfg.get("seed"))
+        self.observation_shape = (3,)
+        self.num_actions = 2
+        self._t = 0
+        self._cue = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self._t = 0
+        self._cue = int(self.rng.integers(2))
+        return np.array([1.0, float(self._cue), 0.0], np.float32), {}
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self.length
+        if done:
+            reward = 1.0 if int(action) == self._cue else 0.0
+        else:
+            reward = 0.0
+        obs = np.array([0.0, 0.0, self._t / self.length], np.float32)
+        return obs, reward, done, False, {}
+
+
+register_env("MemoryChain", lambda cfg: MemoryChainEnv(cfg))
+
+
+class LSTMQNet(nn.Module):
+    """Dense torso -> LSTM -> Q head, scanned over time."""
+
+    num_actions: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, carry, obs_seq):
+        # obs_seq: [B, T, D]; carry: LSTM (c, h) each [B, hidden]
+        x = nn.relu(nn.Dense(self.hidden, name="torso")(obs_seq))
+        lstm = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1, out_axes=1,
+        )(self.hidden, name="lstm")
+        carry, h_seq = lstm(carry, x)
+        q = nn.Dense(self.num_actions, name="q")(h_seq)  # [B, T, A]
+        return carry, q
+
+    @staticmethod
+    def initial_carry(batch: int, hidden: int):
+        zeros = jnp.zeros((batch, hidden), jnp.float32)
+        return (zeros, zeros)
+
+
+class R2D2Module:
+    """Params + jitted sequence forward and single-step acting."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 64,
+                 seed: int = 0):
+        self.num_actions = num_actions
+        self.obs_dim = obs_dim
+        self.hidden = hidden
+        self.net = LSTMQNet(num_actions, hidden)
+        carry = LSTMQNet.initial_carry(1, hidden)
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), carry,
+            jnp.zeros((1, 1, obs_dim), jnp.float32),
+        )["params"]
+
+        def seq_q(params, carry, obs_seq):
+            return self.net.apply({"params": params}, carry, obs_seq)
+
+        self.seq_q = jax.jit(seq_q)
+
+        def step_q(params, carry, obs):
+            carry, q = self.net.apply(
+                {"params": params}, carry, obs[:, None, :]
+            )
+            return carry, q[:, 0]
+
+        self.step_q = jax.jit(step_q)
+
+    def initial_state(self):
+        return LSTMQNet.initial_carry(1, self.hidden)
+
+    def get_state(self):
+        return jax.device_get(self.params)
+
+    def set_state(self, params):
+        self.params = jax.device_put(params)
+
+
+class SequenceReplayBuffer:
+    """Stores fixed-length sequences (one per episode window) with their
+    initial recurrent state (R2D2's stored-state strategy)."""
+
+    def __init__(self, capacity: int = 2_000, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._seqs: List[Dict[str, np.ndarray]] = []
+        self._next = 0
+        self.rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return len(self._seqs)
+
+    def add(self, seq: Dict[str, np.ndarray]):
+        if len(self._seqs) < self.capacity:
+            self._seqs.append(seq)
+        else:
+            self._seqs[self._next] = seq
+            self._next = (self._next + 1) % self.capacity
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self.rng.integers(0, len(self._seqs), size=n)
+        picked = [self._seqs[i] for i in idx]
+        return {
+            k: np.stack([p[k] for p in picked]) for k in picked[0]
+        }
+
+
+class R2D2EnvRunner:
+    """Epsilon-greedy rollouts carrying LSTM state; emits fixed-length
+    episode sequences padded with a validity mask."""
+
+    def __init__(self, env_spec, env_config, module_kwargs: Dict,
+                 seq_len: int, seed: int = 0):
+        self.env = make_env(env_spec, env_config)
+        obs_dim = int(np.prod(self.env.observation_shape))
+        self.module = R2D2Module(obs_dim, self.env.num_actions,
+                                 **module_kwargs)
+        self.seq_len = seq_len
+        self.rng = np.random.default_rng(seed)
+        self._returns: List[float] = []
+
+    def ping(self):
+        return "pong"
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+
+    def _episode(self, epsilon: float):
+        obs, _ = self.env.reset(seed=int(self.rng.integers(2**31)))
+        carry = self.module.initial_state()
+        rows = {k: [] for k in ("obs", "actions", "rewards", "dones")}
+        total = 0.0
+        for _ in range(self.seq_len):
+            carry, q = self.module.step_q(
+                self.module.params, carry,
+                np.asarray(obs, np.float32)[None, :],
+            )
+            if epsilon > 0.0 and self.rng.random() < epsilon:
+                a = int(self.rng.integers(self.env.num_actions))
+            else:
+                a = int(np.argmax(np.asarray(q)[0]))
+            nobs, r, term, trunc, _ = self.env.step(a)
+            rows["obs"].append(np.asarray(obs, np.float32))
+            rows["actions"].append(a)
+            rows["rewards"].append(float(r))
+            rows["dones"].append(bool(term))
+            total += float(r)
+            obs = nobs
+            if term or trunc:
+                break
+        # final obs = the bootstrap observation for a truncated/cut
+        # sequence (terminal sequences gate it off via dones anyway)
+        return rows, total, np.asarray(obs, np.float32)
+
+    def sample(self, num_episodes: int, epsilon: float) -> List[Dict]:
+        out = []
+        for _ in range(num_episodes):
+            rows, total, final_obs = self._episode(epsilon)
+            self._returns.append(total)
+            T = len(rows["actions"])
+            L = self.seq_len
+            seq = {
+                "obs": np.zeros((L + 1, self.module.obs_dim), np.float32),
+                "actions": np.zeros(L, np.int32),
+                "rewards": np.zeros(L, np.float32),
+                "dones": np.ones(L, bool),
+                "mask": np.zeros(L, np.float32),
+            }
+            seq["obs"][:T] = np.stack(rows["obs"])
+            # slot T holds the bootstrap observation: required for
+            # truncated (non-terminal) sequences, harmless for terminal
+            # ones where dones gates the bootstrap off
+            seq["obs"][T] = final_obs
+            seq["actions"][:T] = rows["actions"]
+            seq["rewards"][:T] = rows["rewards"]
+            seq["dones"][:T] = rows["dones"]
+            seq["mask"][:T] = 1.0
+            out.append(seq)
+        return out
+
+    def evaluate(self, num_episodes: int = 20) -> Dict[str, float]:
+        totals = [self._episode(0.0)[1] for _ in range(num_episodes)]
+        return {"evaluation/episode_return_mean": float(np.mean(totals))}
+
+    def get_metrics(self) -> Dict[str, float]:
+        out = {
+            "episodes_this_iter": len(self._returns),
+            "episode_return_mean": float(np.mean(self._returns))
+            if self._returns else float("nan"),
+        }
+        self._returns = []
+        return out
+
+
+class R2D2Learner:
+    """Sequence TD: unroll online + target LSTMs over each sequence,
+    double-Q targets per step, masked loss (burn-in prefix excluded)."""
+
+    def __init__(self, module: R2D2Module, config):
+        self.module = module
+        self.config = config
+        gamma = config.gamma
+        burn_in = int(getattr(config, "burn_in", 0))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(getattr(config, "grad_clip", 10.0)),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.tx.init(module.params)
+        self.target_params = jax.tree.map(jnp.copy, module.params)
+        net = module.net
+        hidden = module.hidden
+
+        def unroll(params, obs_full):
+            B = obs_full.shape[0]
+            carry = LSTMQNet.initial_carry(B, hidden)
+            _, q = net.apply({"params": params}, carry, obs_full)
+            return q  # [B, L+1, A]
+
+        def loss_fn(params, target_params, mb):
+            obs_full = mb["obs"]           # [B, L+1, D]
+            q_all = unroll(params, obs_full)
+            q_t = q_all[:, :-1]            # [B, L, A]
+            q_sel = jnp.take_along_axis(
+                q_t, mb["actions"][..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            q_tar_all = unroll(target_params, obs_full)
+            # double-Q: online argmax at t+1, target evaluation
+            a_star = jnp.argmax(
+                jax.lax.stop_gradient(q_all[:, 1:]), axis=-1
+            )
+            q_boot = jnp.take_along_axis(
+                q_tar_all[:, 1:], a_star[..., None], axis=-1
+            )[..., 0]
+            y = mb["rewards"] + gamma * (
+                1.0 - mb["dones"].astype(jnp.float32)
+            ) * q_boot
+            td = q_sel - jax.lax.stop_gradient(y)
+            mask = mb["mask"]
+            if burn_in > 0:
+                mask = mask.at[:, :burn_in].set(0.0)
+            loss = (mask * td**2).sum() / jnp.maximum(mask.sum(), 1.0)
+            td_mean = (mask * jnp.abs(td)).sum() / jnp.maximum(
+                mask.sum(), 1.0
+            )
+            return loss, td_mean
+
+        def train_step(params, target_params, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, mb
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "mean_td_error": td}
+
+        self._train_step = jax.jit(train_step)
+
+    def update(self, mb: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jmb = {k: jnp.asarray(v) for k, v in mb.items()}
+        self.module.params, self.opt_state, metrics = self._train_step(
+            self.module.params, self.target_params, self.opt_state, jmb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self):
+        self.target_params = jax.tree.map(jnp.copy, self.module.params)
+
+    def get_weights(self):
+        return self.module.get_state()
+
+    def set_weights(self, params):
+        self.module.set_state(params)
+
+    def get_optimizer_state(self):
+        return {"opt": self.opt_state, "target_params": self.target_params}
+
+    def set_optimizer_state(self, state):
+        if state is None:
+            self.opt_state = self.tx.init(self.module.params)
+            self.target_params = jax.tree.map(jnp.copy, self.module.params)
+        else:
+            self.opt_state = state["opt"]
+            self.target_params = state["target_params"]
+
+
+class R2D2(Algorithm):
+    _learner_cls = R2D2Learner
+
+    def setup(self, _config):
+        cfg = self._algo_config
+        if getattr(cfg, "num_learners", 0) >= 1:
+            raise ValueError("num_learners>=1 is not supported for R2D2")
+        probe = make_env(cfg.env, cfg.env_config)
+        obs_dim = int(np.prod(probe.observation_shape))
+        num_actions = probe.num_actions
+        if hasattr(probe, "close"):
+            probe.close()
+        module_kwargs = {
+            "hidden": cfg.model.get("hidden", 64), "seed": cfg.seed,
+        }
+        self.module = R2D2Module(obs_dim, num_actions, **module_kwargs)
+        self.learner = R2D2Learner(self.module, cfg)
+        runner_cls = ray_tpu.remote(
+            num_cpus=0.5, max_restarts=2, max_task_retries=2,
+            runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
+        )(R2D2EnvRunner)
+        self._runner_factory = lambda i, replacement=False: runner_cls.remote(
+            cfg.env, cfg.env_config, module_kwargs, cfg.seq_len,
+            seed=cfg.seed + i,
+        )
+        self.runners = [
+            self._runner_factory(i) for i in range(cfg.num_env_runners)
+        ]
+        self.eval_runners = []
+        self.buffer = SequenceReplayBuffer(cfg.replay_buffer_capacity,
+                                           seed=cfg.seed)
+        self._timesteps = 0
+        self._since_target_sync = 0
+
+    def _epsilon(self) -> float:
+        start, end, decay = self.config.epsilon
+        frac = min(1.0, self._timesteps / max(1, decay))
+        return float(start + (end - start) * frac)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._sync_weights()
+        eps = self._epsilon()
+        per_runner = max(1, cfg.episodes_per_iteration // max(
+            1, len(self.runners)
+        ))
+        seq_lists = self._with_runner_ft(lambda: ray_tpu.get([
+            r.sample.remote(per_runner, eps) for r in self.runners
+        ]))
+        for seqs in seq_lists:
+            for seq in seqs:
+                self._timesteps += int(seq["mask"].sum())
+                self.buffer.add(seq)
+        if len(self.buffer) < cfg.min_sequences_before_learning:
+            return {"buffer_size": len(self.buffer), "epsilon": eps}
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            metrics = self.learner.update(
+                self.buffer.sample(cfg.minibatch_size)
+            )
+            self._since_target_sync += 1
+            if self._since_target_sync >= cfg.target_sync_every_updates:
+                self.learner.sync_target()
+                self._since_target_sync = 0
+        metrics["buffer_size"] = len(self.buffer)
+        metrics["epsilon"] = eps
+        return metrics
+
+    def _sync_weights(self):
+        params = self.module.get_state()
+        self._with_runner_ft(lambda: ray_tpu.get([
+            r.set_weights.remote(params) for r in self.runners
+        ]))
+
+    def evaluate(self) -> Dict:
+        self._sync_weights()
+        outs = self._with_runner_ft(lambda: ray_tpu.get([
+            r.evaluate.remote() for r in self.runners
+        ]))
+        return {
+            "evaluation/episode_return_mean": float(np.mean([
+                o["evaluation/episode_return_mean"] for o in outs
+            ]))
+        }
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(R2D2)
+        self.lr = 1e-3
+        self.model = {"hidden": 64}
+        self.seq_len = 8
+        self.burn_in = 0
+        self.episodes_per_iteration = 16
+        self.replay_buffer_capacity = 2_000
+        self.min_sequences_before_learning = 32
+        self.minibatch_size = 32
+        self.num_epochs = 4
+        self.target_sync_every_updates = 16
+        self.epsilon = (1.0, 0.05, 3_000)

@@ -50,11 +50,12 @@ def _is_replica_death(exc: BaseException) -> bool:
         cause = exc.cause
         if isinstance(cause, ray_tpu.ActorDiedError):
             return True
-        if isinstance(cause, RuntimeError) and any(
-            p in (cause.args[0] if cause.args else "")
-            for p in _REPLICA_DEATH_PHRASES
-        ):
-            return True
+        if isinstance(cause, RuntimeError):
+            msg = cause.args[0] if cause.args else ""
+            if isinstance(msg, str) and any(
+                p in msg for p in _REPLICA_DEATH_PHRASES
+            ):
+                return True
     return False
 
 
@@ -67,6 +68,8 @@ class DeploymentResponse:
         self._on_settle = on_settle
         self._resubmit = resubmit
         self._settled = False
+        self._cached = None
+        self._has_cached = False
 
     def _settle(self):
         if not self._settled:
@@ -85,6 +88,10 @@ class DeploymentResponse:
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
 
+        if self._has_cached:
+            # result() is idempotent: a successful retry must not re-get
+            # the dead ref (which would resubmit the handler AGAIN)
+            return self._cached
         deadline = None if timeout_s is None \
             else time.monotonic() + timeout_s
         try:
@@ -100,15 +107,19 @@ class DeploymentResponse:
             # contract, exactly as in the reference. The caller's timeout
             # budget is shared across retries, not restarted.
             if self._resubmit is not None and _is_replica_death(e):
-                retry = self._resubmit()
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic()
+                )
+                retry = self._resubmit(route_budget=remaining)
                 if retry is not None:
-                    remaining = None if deadline is None else max(
-                        0.0, deadline - time.monotonic()
-                    )
-                    return retry.result(remaining)
+                    out = retry.result(remaining)
+                    self._cached, self._has_cached = out, True
+                    self._resubmit = None
+                    return out
             raise
         finally:
             self._settle()
+        self._cached, self._has_cached = out, True
         from ray_tpu.serve.replica import STREAM_MARKER
 
         if isinstance(out, dict) and STREAM_MARKER in out:
@@ -363,9 +374,12 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._remote_attempt(args, kwargs, retries_left=3)
 
-    def _remote_attempt(self, args, kwargs, retries_left: int):
+    def _remote_attempt(self, args, kwargs, retries_left: int,
+                        route_budget: Optional[float] = None):
         st = self._state
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + (
+            30.0 if route_budget is None else min(30.0, route_budget)
+        )
         last_err = None
         while time.monotonic() < deadline:
             try:
@@ -385,15 +399,16 @@ class DeploymentHandle:
                 if self._stream:
                     return DeploymentResponseGenerator(ref, on_settle=settle)
 
-                def resubmit(remaining=retries_left):
+                def resubmit(route_budget=None, remaining=retries_left):
                     # replica died mid-request: route again on a fresh
                     # replica table (bounded — not every death is a
-                    # rolling update)
+                    # rolling update; routing shares the caller's budget)
                     if remaining <= 0:
                         return None
                     st.refresh(force=True)
                     return self._remote_attempt(
-                        args, kwargs, retries_left=remaining - 1
+                        args, kwargs, retries_left=remaining - 1,
+                        route_budget=route_budget,
                     )
 
                 return DeploymentResponse(

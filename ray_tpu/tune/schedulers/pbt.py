@@ -202,6 +202,11 @@ class PB2(PopulationBasedTraining):
                               for k in self._keys]
             )
             self._y.append(float(score) - float(st["score"]))
+            # recency window: the GP refit is O(n^3) and old dynamics stop
+            # being predictive anyway (reference PB2 also windows)
+            if len(self._y) > 512:
+                self._X = self._X[-512:]
+                self._y = self._y[-512:]
         return super().on_trial_result(controller, trial, result)
 
     def _make_explored_config(self, donor_config: Dict) -> Dict:

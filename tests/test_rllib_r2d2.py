@@ -1,0 +1,87 @@
+"""R2D2 recurrent DQN (ray parity: rllib/algorithms/r2d2). The memory
+task isolates what recurrence buys: the cue is visible only at t=0 and
+must be acted on at the end, so any memoryless policy scores 0.5 in
+expectation while the LSTM policy can reach ~1.0."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.r2d2 import (
+    MemoryChainEnv,
+    R2D2Config,
+    R2D2Module,
+    SequenceReplayBuffer,
+)
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_memory_env_semantics():
+    env = MemoryChainEnv({"length": 3, "seed": 0})
+    obs, _ = env.reset(seed=5)
+    cue = int(obs[1])
+    assert obs[0] == 1.0  # cue marker set only at t=0
+    obs, r, done, _, _ = env.step(0)
+    assert obs[0] == 0.0 and r == 0.0 and not done
+    env.step(0)
+    _, r, done, _, _ = env.step(cue)
+    assert done and r == 1.0
+
+
+def test_lstm_carries_state():
+    m = R2D2Module(obs_dim=3, num_actions=2, hidden=16, seed=0)
+    obs = np.random.default_rng(0).normal(size=(1, 3)).astype(np.float32)
+    c0 = m.initial_state()
+    c1, q1 = m.step_q(m.params, c0, obs)
+    c2, q2 = m.step_q(m.params, c1, obs)
+    # same observation, different hidden state -> different Q
+    assert not np.allclose(np.asarray(q1), np.asarray(q2))
+    # stepwise unroll == sequence unroll
+    seq = np.repeat(obs[:, None, :], 2, axis=1)
+    _, q_seq = m.seq_q(m.params, c0, seq)
+    np.testing.assert_allclose(np.asarray(q_seq)[0, 0], np.asarray(q1)[0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(q_seq)[0, 1], np.asarray(q2)[0],
+                               rtol=1e-5)
+
+
+def test_sequence_buffer_roundtrip():
+    buf = SequenceReplayBuffer(capacity=4, seed=0)
+    for i in range(6):  # overfill: ring wraps
+        buf.add({"x": np.full(3, i, np.float32)})
+    assert len(buf) == 4
+    mb = buf.sample(8)
+    assert mb["x"].shape == (8, 3)
+    assert set(np.unique(mb["x"])) <= {2.0, 3.0, 4.0, 5.0}
+
+
+def test_r2d2_solves_memory_task(ray_cluster):
+    cfg = (
+        R2D2Config()
+        .environment("MemoryChain", env_config={"length": 4})
+        .env_runners(num_env_runners=1)
+        .training(lr=2e-3, minibatch_size=32, num_epochs=8,
+                  episodes_per_iteration=32, seq_len=4,
+                  min_sequences_before_learning=64,
+                  epsilon=(1.0, 0.05, 1_500))
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        best = 0.0
+        for _ in range(30):
+            algo.train()
+            score = algo.evaluate()["evaluation/episode_return_mean"]
+            best = max(best, score)
+            if best >= 0.95:
+                break
+        # memoryless chance is 0.5; require decisively-above-chance recall
+        assert best >= 0.95, best
+    finally:
+        algo.stop()
