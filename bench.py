@@ -137,6 +137,14 @@ def main():
 
     if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent compile cache: a re-run (or a driver retry) skips the
+        # multi-minute tunnel compiles entirely on a warm cache
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/ray_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax or relayed backend without cache support
 
     from ray_tpu.models import gpt2
 

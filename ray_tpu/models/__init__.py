@@ -1,0 +1,11 @@
+"""Model zoo: flagship TPU-native model families.
+
+- gpt2: the benchmark LM (flash attention, chunked loss, TP/PP/SP builders)
+- llama: decoder with RoPE/GQA + KV-cache serving path
+- vision: ViT and ResNet
+- moe_lm: Switch-Transformer MoE LM (GSPMD expert parallelism)
+"""
+
+from ray_tpu.models import gpt2, llama, moe_lm, vision
+
+__all__ = ["gpt2", "llama", "moe_lm", "vision"]

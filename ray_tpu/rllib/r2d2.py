@@ -25,8 +25,7 @@ import optax
 
 import ray_tpu
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.env import make_env, register_env
-from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.env import env_spaces, make_env, register_env
 
 
 class MemoryChainEnv:
@@ -130,8 +129,11 @@ class R2D2Module:
 
 
 class SequenceReplayBuffer:
-    """Stores fixed-length sequences (one per episode window) with their
-    initial recurrent state (R2D2's stored-state strategy)."""
+    """Stores fixed-length EPISODE-ALIGNED sequences. Every sequence
+    starts at an env reset, where the zero recurrent state is exact — so
+    no carry is stored and the learner unrolls from zeros. Extending to
+    mid-episode windows requires storing the carry (R2D2's stored-state
+    strategy) and making ``burn_in`` load-bearing."""
 
     def __init__(self, capacity: int = 2_000, seed: Optional[int] = None):
         self.capacity = capacity
@@ -164,9 +166,9 @@ class R2D2EnvRunner:
     def __init__(self, env_spec, env_config, module_kwargs: Dict,
                  seq_len: int, seed: int = 0):
         self.env = make_env(env_spec, env_config)
-        obs_dim = int(np.prod(self.env.observation_shape))
-        self.module = R2D2Module(obs_dim, self.env.num_actions,
-                                 **module_kwargs)
+        obs_shape, num_actions = env_spaces(self.env)
+        obs_dim = int(np.prod(obs_shape))
+        self.module = R2D2Module(obs_dim, num_actions, **module_kwargs)
         self.seq_len = seq_len
         self.rng = np.random.default_rng(seed)
         self._returns: List[float] = []
@@ -188,7 +190,7 @@ class R2D2EnvRunner:
                 np.asarray(obs, np.float32)[None, :],
             )
             if epsilon > 0.0 and self.rng.random() < epsilon:
-                a = int(self.rng.integers(self.env.num_actions))
+                a = int(self.rng.integers(self.module.num_actions))
             else:
                 a = int(np.argmax(np.asarray(q)[0]))
             nobs, r, term, trunc, _ = self.env.step(a)
@@ -342,8 +344,8 @@ class R2D2(Algorithm):
         if getattr(cfg, "num_learners", 0) >= 1:
             raise ValueError("num_learners>=1 is not supported for R2D2")
         probe = make_env(cfg.env, cfg.env_config)
-        obs_dim = int(np.prod(probe.observation_shape))
-        num_actions = probe.num_actions
+        obs_shape, num_actions = env_spaces(probe)
+        obs_dim = int(np.prod(obs_shape))
         if hasattr(probe, "close"):
             probe.close()
         module_kwargs = {

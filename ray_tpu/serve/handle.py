@@ -110,8 +110,14 @@ class DeploymentResponse:
                 remaining = None if deadline is None else max(
                     0.0, deadline - time.monotonic()
                 )
-                retry = self._resubmit(route_budget=remaining)
+                retry = None
+                if remaining is None or remaining > 0.0:
+                    retry = self._resubmit(route_budget=remaining)
                 if retry is not None:
+                    # routing consumed part of the budget: recompute
+                    remaining = None if deadline is None else max(
+                        0.0, deadline - time.monotonic()
+                    )
                     out = retry.result(remaining)
                     self._cached, self._has_cached = out, True
                     self._resubmit = None
@@ -119,7 +125,6 @@ class DeploymentResponse:
             raise
         finally:
             self._settle()
-        self._cached, self._has_cached = out, True
         from ray_tpu.serve.replica import STREAM_MARKER
 
         if isinstance(out, dict) and STREAM_MARKER in out:
@@ -138,6 +143,7 @@ class DeploymentResponse:
                 "this deployment method is a generator; call it with "
                 ".options(stream=True).remote(...) and iterate the result"
             )
+        self._cached, self._has_cached = out, True
         return out
 
     @property

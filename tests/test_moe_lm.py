@@ -1,0 +1,87 @@
+"""Switch-Transformer LM (ray_tpu.models.moe_lm): forward, training,
+aux-loss wiring, and GSPMD expert-parallel parity on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt2, moe_lm
+
+
+def _batch(bs=4, seq=16, vocab=128, seed=1):
+    return gpt2.synthetic_batch(jax.random.PRNGKey(seed), bs, seq, vocab)
+
+
+def test_forward_and_param_structure():
+    cfg = moe_lm.MoELMConfig.small_test()
+    model, params = moe_lm.init_params(cfg, jax.random.PRNGKey(0))
+    logits = model.apply({"params": params}, jnp.zeros((2, 8), jnp.int32))
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    # every block is MoE (moe_every=1): expert tensors present per block
+    for i in range(cfg.n_layer):
+        blk = params[f"h_{i}"]
+        assert blk["wi"].shape == (cfg.num_experts, cfg.n_embd,
+                                   4 * cfg.n_embd)
+
+
+def test_training_reduces_loss_and_reports_aux():
+    cfg = moe_lm.MoELMConfig.small_test()
+    model, params, tx, opt = moe_lm.make_train_state(
+        cfg, jax.random.PRNGKey(0), learning_rate=1e-2
+    )
+    step = moe_lm.build_train_step(model, tx, donate=False)
+    batch = _batch(vocab=cfg.vocab_size)
+    losses, auxes = [], []
+    for _ in range(12):
+        params, opt, loss, lm, aux = step(params, opt, batch)
+        losses.append(float(loss))
+        auxes.append(float(aux))
+    assert losses[-1] < losses[0] * 0.9, losses
+    # Switch load-balance aux is ~1 at balance, >1 when skewed; must be
+    # a live finite signal, not a constant 0
+    assert all(np.isfinite(a) and a > 0.1 for a in auxes)
+
+
+def test_gspmd_ep_matches_local():
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from ray_tpu.parallel import create_mesh
+
+    cfg = moe_lm.MoELMConfig.small_test()
+    model, params, tx, opt = moe_lm.make_train_state(
+        cfg, jax.random.PRNGKey(0)
+    )
+    step = moe_lm.build_train_step(model, tx, donate=False)
+    batch = _batch(bs=8, vocab=cfg.vocab_size)
+    _, _, loss_local, lm_local, _ = step(params, opt, batch)
+
+    mesh = create_mesh({"data": 2, "ep": 4}, devices=devices[:8])
+    model2, params2, tx2, opt2 = moe_lm.make_train_state(
+        cfg, jax.random.PRNGKey(0)
+    )
+    params2, opt2, place_batch = moe_lm.shard_train_state_ep(
+        params2, opt2, mesh
+    )
+    step2 = moe_lm.build_train_step(model2, tx2, donate=False)
+    p3, o3, loss_ep, lm_ep, _ = step2(params2, opt2, place_batch(batch))
+    # identical math under GSPMD partitioning: same loss to fp tolerance
+    assert abs(float(loss_ep) - float(loss_local)) < 1e-3, (
+        float(loss_ep), float(loss_local)
+    )
+    # expert weights really are sharded over ep
+    sh = p3["h_0"]["wi"].sharding
+    assert "ep" in getattr(sh, "spec", ())
+
+
+def test_capacity_drops_route_through_residual():
+    # capacity_factor near zero forces drops; the model must still run
+    # (dropped tokens ride the residual) and produce finite loss
+    cfg = moe_lm.MoELMConfig.small_test(capacity_factor=0.05)
+    model, params, tx, opt = moe_lm.make_train_state(
+        cfg, jax.random.PRNGKey(0)
+    )
+    step = moe_lm.build_train_step(model, tx, donate=False)
+    _, _, loss, _, _ = step(params, opt, _batch(vocab=cfg.vocab_size))
+    assert np.isfinite(float(loss))
