@@ -20,9 +20,17 @@ from ray_tpu.train.trainer import (
     TensorflowTrainer,
 )
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer
+from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
+                                     Predictor, SklearnPredictor,
+                                     XGBoostPredictor)
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
+    "BatchPredictor",
+    "JaxPredictor",
+    "Predictor",
+    "SklearnPredictor",
+    "XGBoostPredictor",
     "Backend",
     "BackendConfig",
     "BackendExecutor",

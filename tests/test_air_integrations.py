@@ -1,0 +1,84 @@
+"""MLflow / W&B logger callbacks (ray parity: air/integrations/) —
+tested against stub client libraries injected into sys.modules, since
+the real ones are not installed in this image."""
+
+import sys
+import types
+from unittest import mock
+
+import pytest
+
+
+class _Trial:
+    def __init__(self, tid="t1", config=None):
+        self.trial_id = tid
+        self.config = config or {"lr": 0.1}
+
+    def __str__(self):
+        return f"trial_{self.trial_id}"
+
+
+def _stub_mlflow():
+    mlflow = types.ModuleType("mlflow")
+    tracking = types.ModuleType("mlflow.tracking")
+    client = mock.MagicMock()
+    client.get_experiment_by_name.return_value = None
+    client.create_experiment.return_value = "exp1"
+    run = mock.MagicMock()
+    run.info.run_id = "run1"
+    client.create_run.return_value = run
+
+    class MlflowClient:
+        def __new__(cls, *a, **k):
+            return client
+
+    tracking.MlflowClient = MlflowClient
+    mlflow.set_tracking_uri = mock.MagicMock()
+    mlflow.tracking = tracking
+    return mlflow, tracking, client
+
+
+def test_mlflow_callback_lifecycle(monkeypatch):
+    mlflow, tracking, client = _stub_mlflow()
+    monkeypatch.setitem(sys.modules, "mlflow", mlflow)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", tracking)
+    from ray_tpu.air.integrations import MLflowLoggerCallback
+
+    cb = MLflowLoggerCallback(experiment_name="e2e")
+    trial = _Trial()
+    cb.on_trial_start(trial)
+    client.create_run.assert_called_once()
+    client.log_param.assert_any_call("run1", "lr", 0.1)
+    cb.on_trial_result(trial, {"score": 1.5, "training_iteration": 3,
+                               "note": "text-skipped"})
+    client.log_metric.assert_any_call("run1", "score", 1.5, step=3)
+    # non-numeric values never reach the tracker
+    for call in client.log_metric.call_args_list:
+        assert call.args[1] != "note"
+    cb.on_trial_complete(trial)
+    client.set_terminated.assert_called_once_with("run1", status="FINISHED")
+
+
+def test_mlflow_missing_library_fails_at_construction(monkeypatch):
+    monkeypatch.setitem(sys.modules, "mlflow", None)
+    from ray_tpu.air.integrations import MLflowLoggerCallback
+
+    with pytest.raises(ImportError):
+        MLflowLoggerCallback()
+
+
+def test_wandb_callback_lifecycle(monkeypatch):
+    wandb = types.ModuleType("wandb")
+    run = mock.MagicMock()
+    wandb.init = mock.MagicMock(return_value=run)
+    monkeypatch.setitem(sys.modules, "wandb", wandb)
+    from ray_tpu.air.integrations import WandbLoggerCallback
+
+    cb = WandbLoggerCallback(project="p")
+    trial = _Trial()
+    cb.on_trial_start(trial)
+    wandb.init.assert_called_once()
+    cb.on_trial_result(trial, {"score": 2.0})
+    run.log.assert_called_once_with({"score": 2.0})
+    cb.on_trial_error(trial)
+    run.finish.assert_called_once_with(exit_code=1)
