@@ -8,7 +8,7 @@ deployment-graph DSL and the workflow engine.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 
 class DAGNode:
@@ -38,18 +38,23 @@ class DAGNode:
         cache[id(self)] = result
         return result
 
+    def _children(self):
+        """Every DAGNode this node depends on (bound args + kwargs, plus
+        a ClassMethodNode's target) — the single edge definition shared
+        by all graph walkers."""
+        children = list(self._bound_args) + list(self._bound_kwargs.values())
+        if isinstance(self, ClassMethodNode) and isinstance(self._target, DAGNode):
+            children.append(self._target)
+        return [c for c in children if isinstance(c, DAGNode)]
+
     def _collect_input_nodes(self, seen=None):
         seen = seen if seen is not None else set()
         if id(self) in seen:
             return []
         seen.add(id(self))
         found = [self] if isinstance(self, InputNode) else []
-        children = list(self._bound_args) + list(self._bound_kwargs.values())
-        if isinstance(self, ClassMethodNode) and isinstance(self._target, DAGNode):
-            children.append(self._target)
-        for child in children:
-            if isinstance(child, DAGNode):
-                found.extend(child._collect_input_nodes(seen))
+        for child in self._children():
+            found.extend(child._collect_input_nodes(seen))
         return found
 
     def _execute_impl(self, cache):
@@ -105,3 +110,79 @@ class InputNode(DAGNode):
 
     def _execute_impl(self, cache):
         return self._value
+
+
+class _DagRunner:
+    """Cluster-side orchestrator for a compiled DAG: holds the graph and
+    drives every node from INSIDE the cluster, so one driver RPC covers
+    the whole execution."""
+
+    def __init__(self, blob: bytes):
+        import cloudpickle
+
+        self._dag = cloudpickle.loads(blob)
+
+    def run(self, input_value):
+        import ray_tpu
+
+        ref = self._dag.execute(input_value)
+        # resolve in-cluster: the caller gets the VALUE back through this
+        # actor's single return instead of a second fetch round trip
+        return ray_tpu.get(ref)
+
+
+class CompiledDAG:
+    """Repeated-execution form of a DAG (ray parity: the accelerated /
+    compiled DAG of python/ray/dag — ``experimental_compile()``).
+
+    ``DAGNode.execute`` walks the graph on the DRIVER: k nodes cost k
+    submission round trips per call. Compiling ships the graph ONCE to a
+    ``_DagRunner`` actor; each ``execute`` is then a single actor call
+    and the internal hops ride the cluster's direct actor transport.
+    Worth it for small graphs called many times (inference chains,
+    per-step pipelines)."""
+
+    def __init__(self, runner):
+        self._runner = runner
+
+    def execute(self, input_value=None):
+        """Returns an ObjectRef of the DAG's final result value."""
+        return self._runner.run.remote(input_value)
+
+    def teardown(self):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._runner)
+        except Exception:
+            pass
+
+
+def _check_compilable(node: DAGNode, seen: Optional[set] = None):
+    seen = seen if seen is not None else set()
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    if isinstance(node, ClassNode):
+        raise ValueError(
+            "compiled DAGs require pre-created actors: call "
+            ".remote() and bind methods on the HANDLE, not on the class "
+            "(matching the reference's compiled-graph restriction)"
+        )
+    for child in node._children():
+        _check_compilable(child, seen)
+
+
+def experimental_compile(dag: DAGNode, *, num_cpus: float = 0.1
+                         ) -> CompiledDAG:
+    """Compile a DAG for repeated low-overhead execution (see
+    CompiledDAG). The graph must be static: actors already created,
+    functions/args picklable."""
+    import cloudpickle
+
+    import ray_tpu
+
+    _check_compilable(dag)
+    runner_cls = ray_tpu.remote(num_cpus=num_cpus)(_DagRunner)
+    runner = runner_cls.remote(cloudpickle.dumps(dag))
+    return CompiledDAG(runner)

@@ -78,17 +78,21 @@ def test_pb2_exploit_path_deterministic():
             self.exploits.append((trial.trial_id, donor.trial_id,
                                   new_config))
 
-    pb2 = PB2(metric="score", mode="max", perturbation_interval=2,
+    # long interval: deltas accumulate for several reports before the
+    # first exploit, so the asserted perturbation exercises the GP path
+    # (the fit requires >= 4 observations), not the random fallback
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=6,
               hyperparam_bounds={"rate": [0.1, 2.0]}, seed=0)
     lo, hi = _Trial("lo", 0.1), _Trial("hi", 1.9)
     ctl = _Controller([lo, hi])
     pb2.on_trial_add(ctl, lo)
     pb2.on_trial_add(ctl, hi)
-    for t in (1, 2, 3, 4):
+    for t in range(1, 8):
         pb2.on_trial_result(ctl, hi, {"score": 2.0 * t,
                                       "training_iteration": t})
         pb2.on_trial_result(ctl, lo, {"score": 0.1 * t,
                                       "training_iteration": t})
+    assert len(pb2._y) >= 4  # GP path active at the asserted exploit
     assert pb2.num_perturbations > 0
     assert ctl.exploits, "bottom-quantile trial never exploited"
     tid, donor, new_config = ctl.exploits[0]
