@@ -267,8 +267,9 @@ class TuneController:
             for cb in self._callbacks:
                 cb.on_trial_add(trial)
 
-    def _actor_options(self) -> dict:
-        res = dict(self._resources)
+    def _actor_options(self, trial: Optional[Trial] = None) -> dict:
+        res = dict(trial.resources) if trial is not None and trial.resources \
+            else dict(self._resources)
         opts = {"num_cpus": res.pop("CPU", 1.0), "max_restarts": 0}
         if res:
             opts["resources"] = res
@@ -282,7 +283,10 @@ class TuneController:
             "resources": dict(trial.resources),
         }
         handle = None
-        if self._reuse_actors and self._reusable_actors:
+        # actor reuse only at the experiment's base resource footprint: a
+        # resource-changed trial needs a FRESH actor with its own options
+        if (self._reuse_actors and self._reusable_actors
+                and dict(trial.resources or {}) == dict(self._resources)):
             cand = self._reusable_actors.pop()
             try:
                 ok = ray_tpu.get(cand.reset.remote(trial.config, trial_info))
@@ -293,7 +297,9 @@ class TuneController:
             else:
                 self._kill_actor_handle(cand)
         if handle is None:
-            actor_cls = ray_tpu.remote(**self._actor_options())(_TrialActor)
+            actor_cls = ray_tpu.remote(
+                **self._actor_options(trial)
+            )(_TrialActor)
             handle = actor_cls.remote(
                 self._trainable_cls, trial.config, trial_info
             )
@@ -333,7 +339,11 @@ class TuneController:
                 del self._live[ref]
         if handle is None:
             return
-        if graceful and self._reuse_actors:
+        if (graceful and self._reuse_actors
+                and dict(trial.resources or {}) == dict(self._resources)):
+            # only base-footprint actors enter the reuse pool — a
+            # resource-upsized actor would silently hold its larger
+            # reservation under the next trial
             try:
                 ray_tpu.get(handle.stop.remote(), timeout=5.0)
                 self._reusable_actors.append(handle)
@@ -466,6 +476,26 @@ class TuneController:
         trial.checkpoint = payload
         trial.evaluated_params = f"exploited_from={donor.trial_id}"
         self._start_trial(trial)
+
+    def change_trial_resources(self, trial: Trial,
+                               resources: Dict[str, float]) -> bool:
+        """Checkpoint, tear down, and restart ``trial`` with a new
+        resource allocation (ray parity: the controller support behind
+        ResourceChangingScheduler). Returns False if the trial has no
+        live actor to checkpoint."""
+        handle = self._actors.get(trial.trial_id)
+        if handle is None or trial.status != Trial.RUNNING:
+            return False
+        try:
+            payload = ray_tpu.get(handle.save.remote(), timeout=60.0)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("resource change: save failed: %s", e)
+            return False
+        self._teardown_trial_actor(trial, graceful=False)
+        trial.checkpoint = payload
+        trial.resources = dict(resources)
+        self._start_trial(trial)
+        return True
 
     # ------------------------------------------------------------------
     def _startable(self) -> List[Trial]:

@@ -5,6 +5,10 @@ from ray_tpu.tune.schedulers.async_hyperband import (
 from ray_tpu.tune.schedulers.hyperband import HyperBandForBOHB, HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pbt import PB2, PopulationBasedTraining
+from ray_tpu.tune.schedulers.resource_changing import (
+    DistributeResources,
+    ResourceChangingScheduler,
+)
 from ray_tpu.tune.schedulers.trial_scheduler import FIFOScheduler, TrialScheduler
 
 __all__ = [
@@ -14,7 +18,9 @@ __all__ = [
     "HyperBandForBOHB",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "DistributeResources",
     "PB2",
     "PopulationBasedTraining",
+    "ResourceChangingScheduler",
     "TrialScheduler",
 ]

@@ -1,0 +1,119 @@
+"""ResourceChangingScheduler (ray parity:
+tune/schedulers/resource_changing_scheduler.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers.resource_changing import (
+    DistributeResources,
+    ResourceChangingScheduler,
+)
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_reallocation_unit():
+    """Direct scheduler-interface drive: after the interval, the policy's
+    allocation is applied through controller.change_trial_resources."""
+
+    class _Trial:
+        def __init__(self, tid):
+            self.trial_id = tid
+            self.resources = {"CPU": 1.0}
+            self.status = "RUNNING"
+
+    class _Controller:
+        def __init__(self, trials):
+            self.trials = trials
+            self.changes = []
+
+        def change_trial_resources(self, trial, resources):
+            self.changes.append((trial.trial_id, dict(resources)))
+            trial.resources = dict(resources)
+            return True
+
+    t = _Trial("a")
+    ctl = _Controller([t])
+
+    def alloc(controller, trial, base):
+        return {"CPU": 3.0}
+
+    sched = ResourceChangingScheduler(
+        resources_allocation_function=alloc, reallocate_interval=2,
+        metric="m", mode="max",
+    )
+    sched.on_trial_add(ctl, t)
+    assert sched.on_trial_result(ctl, t, {"m": 1}) == TrialScheduler.CONTINUE
+    assert not ctl.changes  # below the interval
+    sched.on_trial_result(ctl, t, {"m": 2})
+    assert ctl.changes == [("a", {"CPU": 3.0})]
+    assert sched.num_resource_changes == 1
+    # no further change while the allocation is already in effect
+    sched.on_trial_result(ctl, t, {"m": 3})
+    sched.on_trial_result(ctl, t, {"m": 4})
+    assert sched.num_resource_changes == 1
+
+
+def test_distribute_resources_floor():
+    class _Trial:
+        def __init__(self, tid):
+            self.trial_id = tid
+            self.resources = {"CPU": 1.0}
+            self.status = "RUNNING"
+
+    class _Controller:
+        def __init__(self, trials):
+            self.trials = trials
+
+    # 2 live trials over a 4-CPU cluster -> 2 CPUs each (floor 1)
+    a, b = _Trial("a"), _Trial("b")
+    ray_tpu.init(num_cpus=4)
+    try:
+        out = DistributeResources()(_Controller([a, b]), a, {"CPU": 1.0})
+        assert out == {"CPU": 2.0}
+        # a single survivor absorbs the whole cluster
+        out = DistributeResources()(_Controller([a]), a, {"CPU": 1.0})
+        assert out == {"CPU": 4.0}
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_e2e_survivor_absorbs_capacity(ray_start_regular):
+    """Two trials on a 4-CPU cluster: once the short trial finishes, the
+    survivor's next reallocation bumps it past its base request, and the
+    trial keeps training through the checkpoint/restart."""
+
+    def objective(config):
+        ck = tune.get_checkpoint()
+        start = ck.to_dict()["i"] if ck else 0
+        for i in range(start, config["steps"]):
+            tune.report(
+                {"step": i + 1},
+                checkpoint=ray_tpu.air.Checkpoint.from_dict({"i": i + 1}),
+            )
+
+    sched = ResourceChangingScheduler(
+        reallocate_interval=3, metric="step", mode="max",
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={"steps": tune.grid_search([3, 25])},
+        tune_config=tune.TuneConfig(
+            scheduler=sched, metric="step", mode="max",
+            max_concurrent_trials=2,
+        ),
+    ).fit()
+    # both trials ran to completion despite mid-run restarts (a trial
+    # restored right at its end finishes without a fresh report, so its
+    # sentinel result may omit "step" — assert on errors + the long
+    # trial's progress instead)
+    assert all(r.error is None for r in grid)
+    assert max(r.metrics.get("step", 0) for r in grid) == 25
+    assert sched.num_resource_changes >= 1
