@@ -275,6 +275,11 @@ class TuneController:
             opts["resources"] = res
         return opts
 
+    def _is_base_footprint(self, trial: Trial) -> bool:
+        """Pool-eligibility invariant: only actors at the experiment's
+        base resource request may enter/leave the reuse pool."""
+        return dict(trial.resources or {}) == dict(self._resources)
+
     def _start_trial(self, trial: Trial):
         trial_info = {
             "trial_id": trial.trial_id,
@@ -286,7 +291,7 @@ class TuneController:
         # actor reuse only at the experiment's base resource footprint: a
         # resource-changed trial needs a FRESH actor with its own options
         if (self._reuse_actors and self._reusable_actors
-                and dict(trial.resources or {}) == dict(self._resources)):
+                and self._is_base_footprint(trial)):
             cand = self._reusable_actors.pop()
             try:
                 ok = ray_tpu.get(cand.reset.remote(trial.config, trial_info))
@@ -340,7 +345,7 @@ class TuneController:
         if handle is None:
             return
         if (graceful and self._reuse_actors
-                and dict(trial.resources or {}) == dict(self._resources)):
+                and self._is_base_footprint(trial)):
             # only base-footprint actors enter the reuse pool — a
             # resource-upsized actor would silently hold its larger
             # reservation under the next trial

@@ -40,6 +40,12 @@ class DistributeResources:
         if not live or total <= 0:
             return None
         base = float(base_resources.get(self.key, 1.0))
+        if base <= 0:
+            # CPU=0 is the Trainer-coordinator convention: the trial actor
+            # deliberately claims nothing while its NESTED train workers
+            # hold the CPUs — upsizing the coordinator would strand those
+            # workers in the infeasible queue and deadlock
+            return None
         share = max(base, math.floor(total / len(live)))
         out = dict(trial.resources)
         out[self.key] = float(share)

@@ -1,3 +1,4 @@
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     placement_group,
@@ -11,6 +12,7 @@ from ray_tpu.util.scheduling_strategies import (
 )
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",
