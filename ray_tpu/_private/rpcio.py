@@ -438,12 +438,26 @@ class EventLoopThread:
         return fut
 
     def stop(self):
-        def _cancel_all():
-            for task in asyncio.all_tasks(self.loop):
-                task.cancel()
+        if not self.thread.is_alive() or not self.loop.is_running():
+            return  # already stopped: draining a dead loop would block
+
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks(self.loop)
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            # let cancellations actually RUN: stopping the loop with
+            # cancelled-but-unfinished tasks makes their destructors spam
+            # "Task was destroyed but it is pending!" on every shutdown
+            await asyncio.gather(*tasks, return_exceptions=True)
 
         try:
-            self.loop.call_soon_threadsafe(_cancel_all)
+            asyncio.run_coroutine_threadsafe(_drain(), self.loop).result(
+                timeout=2.0
+            )
+        except Exception:
+            pass
+        try:
             self.loop.call_soon_threadsafe(self.loop.stop)
             self.thread.join(timeout=5)
         except Exception:

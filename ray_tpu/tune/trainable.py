@@ -24,6 +24,7 @@ TRAINING_ITERATION = "training_iteration"
 # Marks a FunctionTrainable wrapper checkpoint; consumers (ResultGrid)
 # unwrap it rather than handing the wrapper dict to the user.
 FN_CHECKPOINT_KEY = "__fn_checkpoint__"
+FN_LAST_METRICS_KEY = "__fn_last_metrics__"
 
 
 class Trainable:
@@ -178,13 +179,20 @@ class FunctionTrainable(Trainable):
 
     def save_checkpoint(self, checkpoint_dir: Optional[str] = None) -> Optional[Dict]:
         # Sentinel key so downstream consumers (ResultGrid) can tell this
-        # wrapper apart from a user-authored checkpoint dict.
-        return {FN_CHECKPOINT_KEY: self._last_checkpoint}
+        # wrapper apart from a user-authored checkpoint dict. The last
+        # reported metrics ride along so a restored trial that finishes
+        # WITHOUT reporting again (restored right at its end — e.g. after
+        # a PBT exploit or a resource-change restart) still ends with its
+        # real metrics instead of a bare done sentinel.
+        return {FN_CHECKPOINT_KEY: self._last_checkpoint,
+                FN_LAST_METRICS_KEY: self._last_metrics}
 
     def load_checkpoint(self, checkpoint: Optional[Dict]):
         self._restore_checkpoint = checkpoint
         if checkpoint and checkpoint.get(FN_CHECKPOINT_KEY) is not None:
             self._last_checkpoint = checkpoint[FN_CHECKPOINT_KEY]
+        if checkpoint and checkpoint.get(FN_LAST_METRICS_KEY) is not None:
+            self._last_metrics = dict(checkpoint[FN_LAST_METRICS_KEY])
 
     def cleanup(self):
         self._stop_event.set()

@@ -110,10 +110,9 @@ def test_e2e_survivor_absorbs_capacity(ray_start_regular):
             max_concurrent_trials=2,
         ),
     ).fit()
-    # both trials ran to completion despite mid-run restarts (a trial
-    # restored right at its end finishes without a fresh report, so its
-    # sentinel result may omit "step" — assert on errors + the long
-    # trial's progress instead)
+    # both trials ran to completion despite mid-run restarts; a trial
+    # restored right at its end still ends with its real last metrics
+    # (persisted through the function-trainable checkpoint)
     assert all(r.error is None for r in grid)
-    assert max(r.metrics.get("step", 0) for r in grid) == 25
+    assert sorted(r.metrics["step"] for r in grid) == [3, 25]
     assert sched.num_resource_changes >= 1
