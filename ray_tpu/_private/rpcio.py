@@ -438,9 +438,20 @@ class EventLoopThread:
         return fut
 
     def stop(self):
-        if not self.thread.is_alive() or not self.loop.is_running():
-            return  # already stopped: draining a dead loop would block
+        if self.thread.is_alive() and self.loop.is_running():
+            self._drain_tasks()
+        # ALWAYS queue the stop + join while the thread lives: a loop that
+        # has not reached run_forever yet still executes queued callbacks
+        # once it starts, so this is the path that keeps an early-shutdown
+        # worker from leaking a spinning io thread
+        if self.thread.is_alive():
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+                self.thread.join(timeout=5)
+            except Exception:
+                pass
 
+    def _drain_tasks(self):
         async def _drain():
             tasks = [t for t in asyncio.all_tasks(self.loop)
                      if t is not asyncio.current_task()]
@@ -455,10 +466,5 @@ class EventLoopThread:
             asyncio.run_coroutine_threadsafe(_drain(), self.loop).result(
                 timeout=2.0
             )
-        except Exception:
-            pass
-        try:
-            self.loop.call_soon_threadsafe(self.loop.stop)
-            self.thread.join(timeout=5)
         except Exception:
             pass

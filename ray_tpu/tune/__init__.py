@@ -4,6 +4,7 @@ Trials are actors on the ray_tpu runtime; a TPU trial's resource request is
 a whole slice-gang (e.g. {"TPU": 4}) so the scheduler packs it onto ICI.
 """
 
+from ray_tpu.tune.analysis import ExperimentAnalysis
 from ray_tpu.tune.experiment.trial import Trial
 from ray_tpu.tune.logger import Callback, CSVLoggerCallback, JsonLoggerCallback
 from ray_tpu.tune.result_grid import ResultGrid
@@ -47,6 +48,7 @@ from ray_tpu.tune.tuner import (
 )
 
 __all__ = [
+    "ExperimentAnalysis",
     "Callback",
     "CSVLoggerCallback",
     "CombinedStopper",

@@ -1,0 +1,56 @@
+"""ExperimentAnalysis: offline queries against a finished experiment dir
+(ray parity: tune/analysis/experiment_analysis.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.analysis import ExperimentAnalysis
+
+
+@pytest.fixture(scope="module")
+def finished_experiment():
+    ray_tpu.init(num_cpus=4)
+
+    def objective(config):
+        for i in range(5):
+            tune.report({"score": config["rate"] * (i + 1)})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"rate": tune.grid_search([1.0, 3.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    yield grid.experiment_path
+    ray_tpu.shutdown()
+
+
+def test_offline_best_queries(finished_experiment):
+    ea = ExperimentAnalysis(finished_experiment)
+    # defaults recovered from the experiment snapshot
+    assert ea.default_metric == "score" and ea.default_mode == "max"
+    assert len(ea.trials) == 3
+    best = ea.best_result()
+    assert best["score"] == pytest.approx(15.0)  # rate 3.0 * 5 steps
+    assert ea.best_config()["rate"] == 3.0
+    # explicit min flips the choice
+    worst_cfg = ea.best_config(metric="score", mode="min")
+    assert worst_cfg["rate"] == 1.0
+
+
+def test_dataframes(finished_experiment):
+    ea = ExperimentAnalysis(finished_experiment)
+    df = ea.dataframe()
+    assert len(df) == 3
+    assert set(df["config/rate"]) == {1.0, 2.0, 3.0}
+    assert df["score"].max() == pytest.approx(15.0)
+    per_trial = ea.trial_dataframes()
+    # 5 reports + the terminal duplicate-result line
+    assert all(len(v) in (5, 6) for v in per_trial.values())
+
+
+def test_missing_dir_and_empty_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ExperimentAnalysis(str(tmp_path / "nope"))
+    with pytest.raises(ValueError, match="result.json"):
+        ExperimentAnalysis(str(tmp_path))
