@@ -25,6 +25,7 @@ from ray_tpu._private.worker import (
     ActorDiedError,
     GetTimeoutError,
     TaskCancelledError,
+    WorkerDiedError,
 )
 from ray_tpu.api import (
     ActorClass,
@@ -82,6 +83,7 @@ def __getattr__(name):
 __all__ = [
     "ActorClass",
     "ActorDiedError",
+    "WorkerDiedError",
     "ActorHandle",
     "GetTimeoutError",
     "ObjectRef",

@@ -870,7 +870,8 @@ class Raylet:
                     )
                 else:
                     reason = f"worker died while executing (pid={w.proc.pid})"
-                await self._send_task_failure(qt.spec, reason, retriable=True)
+                await self._send_task_failure(qt.spec, reason, retriable=True,
+                                              worker_died=True)
         self._dispatch_event.set()
 
     # ------------------------------------------------------------------
@@ -1373,13 +1374,14 @@ class Raylet:
                 pass
 
     async def _send_task_failure(self, spec: TaskSpec, reason: str, retriable: bool,
-                                 lost_object: Optional[bytes] = None):
+                                 lost_object: Optional[bytes] = None,
+                                 worker_died: bool = False):
         await self._route_to_owner(
             spec.owner,
             "task_result",
             {"task_id": spec.task_id, "results": None, "error": reason,
              "system_error": True, "retriable": retriable, "attempt": spec.attempt,
-             "lost_object": lost_object},
+             "lost_object": lost_object, "worker_died": worker_died},
         )
         await self._notify_spill_origin(spec)
 
@@ -1638,7 +1640,8 @@ class Raylet:
         except Exception:
             # actor worker died mid-task; GCS failure path notifies owner of
             # actor death; report retriable failure for this call.
-            await self._send_task_failure(spec, "actor worker died", retriable=True)
+            await self._send_task_failure(spec, "actor worker died",
+                                          retriable=True, worker_died=True)
             return
         await self._deliver_result(spec, result)
 

@@ -78,6 +78,14 @@ class ActorDiedError(RuntimeError):
     pass
 
 
+class WorkerDiedError(RuntimeError):
+    """The worker process executing the task died (crash, OOM kill, node
+    loss) — a SYSTEM failure, typed so callers (e.g. serve's replica-death
+    retry) can match on class instead of message text. Analog of
+    ray.exceptions.WorkerCrashedError."""
+    pass
+
+
 class TaskCancelledError(RuntimeError):
     pass
 
@@ -597,7 +605,9 @@ class CoreWorker:
                     logger.exception(
                         "direct-push loss handling failed for %s", spec.name
                     )
-                    self._fail_returns(spec, "leased worker lost")
+                    self._fail_returns_exc(
+                        spec, WorkerDiedError("leased worker lost")
+                    )
                 return
             # The spec is consumed from the queue: any failure past this
             # point MUST still resolve the task's returns, or the caller's
@@ -781,7 +791,7 @@ class CoreWorker:
                 await asyncio.sleep(0.1)
         await self.rpc_task_result(self.raylet, {
             "task_id": spec.task_id, "results": None,
-            "error": reason, "system_error": True,
+            "error": reason, "system_error": True, "worker_died": True,
             "retriable": True, "attempt": spec.attempt,
         })
 
@@ -1288,6 +1298,8 @@ class CoreWorker:
                 exc = ActorDiedError(p["error"])
             elif p.get("cancelled"):
                 exc = TaskCancelledError(p["error"])
+            elif p.get("worker_died"):
+                exc = WorkerDiedError(p["error"])
             else:
                 exc = RuntimeError(p["error"])
             sv = serialization.serialize_error(exc, spec.name if spec else "")

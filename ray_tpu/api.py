@@ -21,6 +21,7 @@ from ray_tpu._private.worker import (
     CoreWorker,
     GetTimeoutError,
     TaskCancelledError,
+    WorkerDiedError,
     global_worker,
 )
 from ray_tpu._private.serialization import TaskError

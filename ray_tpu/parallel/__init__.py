@@ -8,6 +8,7 @@ from ray_tpu.parallel.collectives import (
 )
 from ray_tpu.parallel.mesh_utils import (
     auto_mesh,
+    create_hybrid_mesh,
     create_mesh,
     data_sharding,
     logical_to_physical,
@@ -20,6 +21,7 @@ __all__ = [
     "all_gather",
     "auto_mesh",
     "compiled_allreduce",
+    "create_hybrid_mesh",
     "create_mesh",
     "data_sharding",
     "logical_to_physical",

@@ -662,11 +662,18 @@ class DQN(Algorithm):
         for _ in range(cfg.num_epochs):
             batch = self.buffer.sample(cfg.minibatch_size)
             metrics = self.learner.update(batch)
-            if "batch_indexes" in batch and hasattr(
-                self.buffer, "update_priorities"
-            ):
+            # last_td_abs is set by DQNLearner only; under LearnerGroup
+            # there is no such attribute (multi-learner DQN is rejected at
+            # setup since DQNLearner has no DDP step), so a learner that
+            # doesn't expose it leaves priorities unrefreshed rather than
+            # crashing.
+            td_abs = getattr(self.learner, "last_td_abs", None)
+            if (td_abs is not None and "batch_indexes" in batch
+                    and hasattr(self.buffer, "update_priorities")):
+                # truncate defensively: a learner returning fewer TDs than
+                # the batch must not misalign index->priority pairs
                 self.buffer.update_priorities(
-                    batch["batch_indexes"], self.learner.last_td_abs
+                    batch["batch_indexes"][:len(td_abs)], td_abs
                 )
             self._since_target_sync += 1
             if self._since_target_sync >= max(

@@ -460,8 +460,20 @@ class QMIX(Algorithm):
         """Greedy joint action for one env step (decentralized
         execution). Agents are ordered exactly as during training
         (env.agent_ids) — sorting obs keys would permute the one-hot
-        agent IDs once ids reach double digits."""
-        ids = [a for a in self.agent_ids if a in obs]
+        agent IDs once ids reach double digits. The net's input layout is
+        fixed at n_agents slots, so stacking a subset would both shrink
+        the input dim and permute the id one-hots; all agents must be
+        observed every step (the runner guarantees this)."""
+        missing = [a for a in self.agent_ids if a not in obs]
+        if missing:
+            raise ValueError(
+                f"QMIX.compute_actions needs an observation for every "
+                f"agent; missing {missing}. The joint Q network stacks "
+                f"all {len(self.agent_ids)} agents' obs in training "
+                f"order — a partial dict would misalign the agent-id "
+                f"encoding."
+            )
+        ids = list(self.agent_ids)
         stacked = _stack_obs(obs, ids)
         a = self.module.actions_greedy(stacked[None])[0]
         return {aid: int(a[i]) for i, aid in enumerate(ids)}

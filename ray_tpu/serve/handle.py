@@ -22,40 +22,24 @@ from ray_tpu.serve._common import REPLICA_PUSH_CHANNEL, SERVE_CONTROLLER_NAME
 _REFRESH_PERIOD_S = 1.0
 
 
-_REPLICA_DEATH_PHRASES = (
-    # exact system-generated messages for a dead/vanished actor worker
-    # (raylet._send_task_failure / worker._fail_returns_exc); matched only
-    # ONE cause-level deep so an application error that merely EMBEDS an
-    # actor death from a downstream call (TaskError nested two deep, or a
-    # traceback string) is never retried — the replica itself is alive and
-    # re-executing its side-effecting handler would break at-most-once.
-    "actor worker died",
-    "worker died while executing",
-    "actor tasks run at-most-once",
-    "leased worker lost",
-)
-
-
 def _is_replica_death(exc: BaseException) -> bool:
     """Did this call fail because its replica actor died (rolling update,
     crash)? Those failures are retriable on ANOTHER replica — serve's
     contract is that redeploys don't drop requests (ray parity: the
-    router's retry on RayActorError)."""
+    router's retry on RayActorError). Matched by TYPE only — the system
+    death paths raise ActorDiedError / WorkerDiedError end-to-end — and
+    only ONE cause-level deep, so an application error that merely EMBEDS
+    an actor death from a downstream call is never retried: the replica
+    itself is alive and re-executing its side-effecting handler would
+    break at-most-once."""
     import ray_tpu
     from ray_tpu._private.serialization import TaskError
 
-    if isinstance(exc, ray_tpu.ActorDiedError):
+    death = (ray_tpu.ActorDiedError, ray_tpu.WorkerDiedError)
+    if isinstance(exc, death):
         return True
-    if isinstance(exc, TaskError):
-        cause = exc.cause
-        if isinstance(cause, ray_tpu.ActorDiedError):
-            return True
-        if isinstance(cause, RuntimeError):
-            msg = cause.args[0] if cause.args else ""
-            if isinstance(msg, str) and any(
-                p in msg for p in _REPLICA_DEATH_PHRASES
-            ):
-                return True
+    if isinstance(exc, TaskError) and isinstance(exc.cause, death):
+        return True
     return False
 
 

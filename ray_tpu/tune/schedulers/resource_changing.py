@@ -85,6 +85,24 @@ class ResourceChangingScheduler(TrialScheduler):
             raise AttributeError(name)
         return getattr(self._base, name)
 
+    # __getattr__ never fires for hooks TrialScheduler defines concretely,
+    # so forward those explicitly — a wrapped ASHA/HyperBand must learn
+    # about errored/removed trials or its bracket state leaks
+    def on_trial_error(self, controller, trial):
+        self._base_resources.pop(trial.trial_id, None)
+        self._since_check.pop(trial.trial_id, None)
+        return self._base.on_trial_error(controller, trial)
+
+    def on_trial_remove(self, controller, trial):
+        self._base_resources.pop(trial.trial_id, None)
+        self._since_check.pop(trial.trial_id, None)
+        return self._base.on_trial_remove(controller, trial)
+
+    def debug_string(self) -> str:
+        return (f"ResourceChangingScheduler "
+                f"({self.num_resource_changes} changes) wrapping "
+                f"{self._base.debug_string()}")
+
     # -- delegate the scheduling decisions to the wrapped scheduler ----
     def on_trial_add(self, controller, trial):
         self._base_resources[trial.trial_id] = dict(trial.resources or {})
