@@ -127,6 +127,11 @@ _flag("get_stall_dump_s", float, 30.0)
 _flag("direct_lease_pipeline_depth", int, 4)  # in-flight tasks per lease
 _flag("direct_lease_max", int, 16)  # leases per scheduling class per driver
 _flag("direct_lease_linger_s", float, 0.5)  # idle hold before lease return
+_flag("direct_push_batch_max", int, 64)  # specs per execute_task_batch frame
+# batch frames in flight per actor sender: >1 keeps the pipe full while the
+# next burst accumulates behind it (unbounded pipelining would drain the
+# queue one spec at a time and never form a batch)
+_flag("actor_direct_max_inflight", int, 2)
 _flag("direct_actor_calls", bool, True)  # push actor calls to the worker
 # Dispatch / scheduling cadence (raylet loops)
 _flag("dispatch_retry_interval_s", float, 0.01)

@@ -140,6 +140,135 @@ class TaskExecutor:
                 return await self._execute_gated(spec, is_actor_task)
         return await self._execute_gated(spec, is_actor_task)
 
+    # ------------------------------------------------------------------
+    def _batchable(self, spec: TaskSpec) -> bool:
+        """May this call join a single-thread-hop batch run? Plain sync
+        task functions, or strictly sequential (max_concurrency 1,
+        ungrouped) SYNC actor methods — exactly the calls whose semantics
+        a sequential in-order run cannot change. Dynamic-return and traced
+        calls take the per-spec path."""
+        if getattr(spec, "tracing_ctx", None) is not None:
+            return False
+        if spec.num_returns == -1:
+            return False
+        if spec.actor_id is None:
+            fn = self._load_fn(spec.func_blob)
+            return not inspect.iscoroutinefunction(fn)
+        if spec.actor_creation:
+            return False
+        if self.actor_instance is None or self.max_concurrency != 1:
+            return False
+        if self._group_sems or spec.concurrency_group:
+            return False
+        method = getattr(self.actor_instance, spec.method_name, None)
+        return method is not None and not inspect.iscoroutinefunction(method)
+
+    async def execute_task_batch(self, specs, deliver):
+        """Batched execution with STREAMED results: ``deliver(spec,
+        result)`` is awaited the moment each task's result exists, so an
+        early task is never gated on the batch tail (ray.wait semantics).
+        Consecutive batchable sync calls share ONE thread-pool submission
+        (one SimpleQueue hop + GIL handoff instead of one per call — the
+        dominant worker-side cost for short calls); each completion still
+        streams out of the run individually, so a slow task inside a run
+        delays nobody behind it being DELIVERED, only executed."""
+        i, n = 0, len(specs)
+        while i < n:
+            if self._batchable(specs[i]):
+                lead_plain = specs[i].actor_id is None
+                k = 1
+                while (i + k < n and self._batchable(specs[i + k])
+                       and (specs[i + k].actor_id is None) == lead_plain):
+                    k += 1
+                await self._execute_sync_run(specs[i:i + k], deliver)
+            else:
+                k = 1
+                await deliver(specs[i], await self.execute_task(specs[i]))
+            i += k
+
+    async def _execute_sync_run(self, specs, deliver):
+        """Run a contiguous burst of batchable calls in one pool hop,
+        streaming each completion back to the loop thread as it happens
+        (call_soon_threadsafe -> queue -> package + deliver). For actor
+        calls the seq gate is awaited for the FIRST spec only: the burst
+        is one caller's contiguous seq range, so once its head may run
+        the rest follow in order inside the same pool submission; each
+        call's turn advances as its result streams out, so later frames'
+        calls unblock without waiting for the run tail. Plain tasks have
+        no ordering contract and skip the gate."""
+        loop = asyncio.get_running_loop()
+        start = time.time()
+        gated = specs[0].actor_id is not None
+        if gated:
+            await self._await_turn(specs[0].caller_id, specs[0].seq_no)
+        done_q: asyncio.Queue = asyncio.Queue()
+        delivered = 0
+        try:
+            resolved = []
+            for spec in specs:
+                try:
+                    resolved.append(("ok", await self._resolve_args(spec)))
+                except serialization.TaskError as e:
+                    # dependency failed: propagate its error as ours
+                    resolved.append(("err", serialization.serialize_error(
+                        e.cause, spec.name), True))
+                except Exception as e:
+                    resolved.append(("err", serialization.serialize_error(
+                        e, spec.name), False))
+            self.current_job_id = specs[0].job_id
+            self.cw.job_id = specs[0].job_id
+
+            calls = [
+                (getattr(self.actor_instance, spec.method_name)
+                 if spec.actor_id is not None
+                 else self._load_fn(spec.func_blob))
+                for spec in specs
+            ]
+
+            def run_all():
+                for idx, (spec, r, call) in enumerate(
+                    zip(specs, resolved, calls)
+                ):
+                    if r[0] != "ok":
+                        loop.call_soon_threadsafe(
+                            done_q.put_nowait, (idx, False, None)
+                        )
+                        continue
+                    args, kwargs = r[1]
+                    self.current_task_id = spec.task_id
+                    try:
+                        out = (idx, True, call(*args, **kwargs))
+                    except Exception as e:
+                        out = (idx, False, e)
+                    finally:
+                        self.current_task_id = None
+                    loop.call_soon_threadsafe(done_q.put_nowait, out)
+
+            pool_fut = loop.run_in_executor(self.pool, run_all)
+            for _ in range(len(specs)):
+                idx, ok, value = await done_q.get()
+                spec, r = specs[idx], resolved[idx]
+                if r[0] != "ok":
+                    result = self._error_result(r[1], app_error=r[2])
+                elif ok:
+                    result = self._package_returns(spec, value, start)
+                else:
+                    result = self._error_result(
+                        serialization.serialize_error(value, spec.name),
+                        app_error=True,
+                    )
+                if gated:
+                    await self._advance_turn(spec.caller_id)
+                delivered += 1
+                await deliver(spec, result)
+            await pool_fut
+        finally:
+            if gated:
+                # crash path: later frames' calls must not deadlock on
+                # turns the dead run will never advance
+                for _ in range(len(specs) - delivered):
+                    await self._advance_turn(specs[0].caller_id)
+
     async def _execute_gated(self, spec: TaskSpec, is_actor_task: bool):
         try:
             ctx = getattr(spec, "tracing_ctx", None)

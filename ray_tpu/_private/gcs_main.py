@@ -10,6 +10,9 @@ import os
 
 
 async def amain(args):
+    from ray_tpu._private.rpcio import enable_eager_tasks
+
+    enable_eager_tasks(asyncio.get_running_loop())
     from ray_tpu._private.gcs import GcsServer
 
     server = GcsServer(host=args.host, port=args.port,

@@ -159,13 +159,23 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
             assert got.nbytes == arr.nbytes
             del ref, got
             return 2 * arr.nbytes  # bytes moved (put + get)
-        name, bps = _timeit("put gigabytes", run, warmup=1, repeat=2)
+        # warmup=3: the first cycles write fresh tmpfs pages and seed the
+        # store's recycling pool; steady-state puts then memcpy into warm
+        # pages — the regime a training loop's put/free cadence lives in
+        name, bps = _timeit("put gigabytes", run, warmup=3, repeat=3)
         return name, bps / 1e9  # GB/s
+
+    import gc
 
     for key, (display, fn) in benches.items():
         # match either the registry key or the printed display name
         if select and select not in key and select not in display:
             continue
+        # isolate: collect the previous bench's dropped refs and let the
+        # resulting free bursts drain before timing the next bench (the
+        # 10k-refs teardown otherwise bleeds into put bandwidth)
+        gc.collect()
+        time.sleep(0.5)
         name, value = fn()
         record(name, value, "GB/s" if key == "put_gigabytes" else "ops/s")
     if not results:

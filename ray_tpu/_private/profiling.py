@@ -77,6 +77,51 @@ def _dump_asyncio_tasks(signum=None, frame=None):
     sys.stderr.flush()
 
 
+def maybe_profile_thread(role: str, snapshot_interval_s: float = 5.0):
+    """Profile THE CALLING THREAD if RAY_TPU_PROFILE_DIR is set (cProfile
+    instruments only the enabling thread). For loops hosted off-main —
+    the driver's EventLoopThread — where ``maybe_profile`` on the main
+    thread sees nothing but lock waits."""
+    out_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
+    if not out_dir:
+        return
+    import cProfile
+    import threading
+    import time
+
+    prof = cProfile.Profile()
+    try:
+        prof.enable()
+    except ValueError:
+        # 3.12 profiles process-wide: a system process that already runs
+        # maybe_profile() covers this thread — a second profiler would
+        # raise and kill the enabling thread (observed: worker io loop)
+        return
+    path = os.path.join(out_dir, f"{role}-{os.getpid()}.pstats")
+
+    def dump():
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            prof.create_stats()
+            prof.dump_stats(path)
+        except Exception:
+            pass
+        finally:
+            try:
+                prof.enable()
+            except Exception:
+                pass
+
+    def loop():
+        while True:
+            time.sleep(snapshot_interval_s)
+            dump()
+
+    threading.Thread(target=loop, name=f"profile-snap-{role}",
+                     daemon=True).start()
+    atexit.register(dump)
+
+
 def maybe_profile(role: str, snapshot_interval_s: float = 5.0):
     """Enable process-wide profiling if RAY_TPU_PROFILE_DIR is set.
 

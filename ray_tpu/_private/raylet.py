@@ -2108,6 +2108,11 @@ class Raylet:
     def rpc_delete_object(self, conn: Connection, p):
         self.store.delete(ObjectID(p["object_id"]))
 
+    def rpc_delete_objects(self, conn: Connection, p):
+        """Batched GCS free broadcast (one frame per release burst)."""
+        for oid in p["object_ids"]:
+            self.store.delete(ObjectID(oid))
+
     async def rpc_owner_call(self, conn: Connection, p):
         """Route a request to an owning core worker anywhere in the cluster
         (generic transport for the borrower protocol: borrow_add,
@@ -2184,12 +2189,23 @@ class Raylet:
         return {}
 
     async def rpc_free_objects(self, conn: Connection, p):
-        """Tick-batched frees from an owner (one frame per release burst)."""
+        """Tick-batched frees from an owner (one frame per release burst).
+        The LOCAL copy is deleted synchronously — the owner only frees at
+        cluster-wide refcount zero, so this is safe, and it returns the
+        pages to the store's recycling pool NOW instead of after the GCS
+        round-trip (a put/free loop would otherwise never see a warm
+        pool). The GCS broadcast still clears remote copies."""
         for oid in p["object_ids"]:
             try:
-                await self.gcs.request("free_object", {"object_id": oid})
+                self.store.delete(ObjectID(oid))
             except Exception:
                 pass
+        try:
+            await self.gcs.request(
+                "free_objects", {"object_ids": list(p["object_ids"])}
+            )
+        except Exception:
+            pass
         return {}
 
     # ------------------------------------------------------------------

@@ -149,10 +149,11 @@ class Connection:
         empty, so a burst of small control frames written individually
         costs a syscall + receiver wakeup each; joined, the burst is one
         syscall and the peer's recv loop drains it in one poll."""
-        # No deliberate delay: create_task() already defers this past the
-        # currently-running callback, so a burst sent from one handler
-        # coalesces — while a sequential request chain only pays task
-        # scheduling, not a full extra loop tick per RPC.
+        # Explicit yield so the flush always runs past the currently
+        # executing callback: under the loops' EAGER task factory,
+        # create_task would otherwise run this body synchronously inside
+        # the first _enqueue_frame and flush one-frame "bursts".
+        await asyncio.sleep(0)
         async with self._send_lock:
             # loop until drained: frames appended while we're suspended in
             # drain() ride THIS task — a sender that sees the task not done
@@ -384,6 +385,15 @@ def spawn(coro, name: str = None) -> asyncio.Task:
     create_task in system processes must go through here or an equivalent
     live structure."""
     task = asyncio.get_running_loop().create_task(coro, name=name)
+    if task.done():
+        # Eager task factory: the coroutine ran to completion synchronously
+        # inside create_task — registering the done-callback AFTER adding to
+        # _BG_TASKS would fire it immediately (discard before add) and leak
+        # the entry forever. Log any exception and skip the registry.
+        if not task.cancelled() and task.exception() is not None:
+            logger.error("background task %s failed: %r", task.get_name(),
+                         task.exception(), exc_info=task.exception())
+        return task
     _BG_TASKS.add(task)
 
     def _done(t):
@@ -394,6 +404,19 @@ def spawn(coro, name: str = None) -> asyncio.Task:
 
     task.add_done_callback(_done)
     return task
+
+
+def enable_eager_tasks(loop: asyncio.AbstractEventLoop):
+    """Python 3.12 eager task execution: a new task runs synchronously
+    until its first suspension instead of paying a full loop round-trip
+    before its first byte of work. For the control plane's short RPC
+    dispatch handlers this removes one scheduling hop per message — the
+    dominant per-op cost the BENCH_CORE analysis identified. Code that
+    NEEDS deferred execution must make it explicit (``_flush_writes``
+    leads with ``await asyncio.sleep(0)``)."""
+    factory = getattr(asyncio, "eager_task_factory", None)
+    if factory is not None:
+        loop.set_task_factory(factory)
 
 
 def _log_dropped_exception(fut) -> None:
@@ -416,11 +439,16 @@ class EventLoopThread:
 
     def __init__(self, name: str = "rpc-io"):
         self.loop = asyncio.new_event_loop()
+        enable_eager_tasks(self.loop)
         self.thread = threading.Thread(target=self._run, name=name, daemon=True)
         self.thread.start()
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
+        if os.environ.get("RAY_TPU_PROFILE_DIR"):
+            from ray_tpu._private.profiling import maybe_profile_thread
+
+            maybe_profile_thread(f"ioloop-{self.thread.name}")
         self.loop.run_forever()
 
     def run(self, coro, timeout: float = None):
@@ -429,6 +457,14 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def call_soon(self, coro):
+        if not self.loop.is_running():
+            # Shutdown race: close the coroutine (avoids the un-awaited
+            # warning) but RAISE — a silent drop would hang any caller
+            # blocking on a future this coroutine was meant to resolve
+            # (e.g. worker._resolve_owned_missing). Fire-and-forget call
+            # sites already wrap call_soon in try/except.
+            coro.close()
+            raise RuntimeError("event loop is stopped")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         # Fire-and-forget callers never .result() this future, and
         # run_coroutine_threadsafe swallows coroutine exceptions into it —

@@ -216,6 +216,10 @@ class CoreWorker:
         self._direct_events: Dict[tuple, asyncio.Event] = {}
         # direct actor calls: actor_id -> {"q", "running", "conn"}
         self._actor_direct: Dict[bytes, dict] = {}
+        # actor_id -> True when calls are STRICTLY sequential (max_concurrency
+        # 1, no concurrency groups): only then may the direct sender batch
+        # calls into one frame without changing concurrency semantics
+        self._actor_sequential: Dict[bytes, bool] = {}
         # worker-side task-event buffer for direct-push executions
         self._tev_buf: List[dict] = []
         self._tev_flushing = False
@@ -583,45 +587,80 @@ class CoreWorker:
                 except asyncio.TimeoutError:
                     return
                 continue
-            spec = q.popleft()
+            # Adaptive batching: take whatever burst accumulated while the
+            # previous round-trip was in flight (one spec when idle — same
+            # latency as the unbatched path; a deep queue amortizes the
+            # per-message frame/dispatch cost across up to batch_max specs).
+            k = min(len(q), cfg.direct_push_batch_max)
+            batch = [q.popleft() for _ in range(k)]
             if conn is None or conn.closed:
-                # endpoint gone BEFORE anything was sent: the task never
+                # endpoint gone BEFORE anything was sent: the tasks never
                 # started, so reroute via the raylet without consuming a
                 # retry attempt (at-most-once was never at risk)
                 try:
-                    await self.raylet.request("submit_task", {"spec": spec})
-                    self._submit_stage[spec.task_id] = "raylet_reroute"
+                    await self.raylet.request(
+                        "submit_batch", {"specs": batch}
+                    )
+                    for spec in batch:
+                        self._submit_stage[spec.task_id] = "raylet_reroute"
                 except Exception as e:
-                    self._fail_returns(spec, f"task submission failed: {e}")
+                    for spec in batch:
+                        self._fail_returns(
+                            spec, f"task submission failed: {e}"
+                        )
                 return
-            self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
+            for spec in batch:
+                self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
             try:
-                result = await conn.request("execute_task", {"spec": spec})
+                if len(batch) == 1:
+                    results = [await conn.request(
+                        "execute_task", {"spec": batch[0]}
+                    )]
+                else:
+                    # batch results STREAM back as task_result notifies as
+                    # each task finishes (so ray.wait sees early tasks);
+                    # the response is only the completion ack
+                    await conn.request(
+                        "execute_task_batch", {"specs": batch}
+                    )
+                    results = None
             except Exception:
-                self._submit_stage[spec.task_id] = "worker_lost"
-                try:
-                    await self._direct_worker_lost(spec, lease)
-                except Exception:
-                    logger.exception(
-                        "direct-push loss handling failed for %s", spec.name
-                    )
-                    self._fail_returns_exc(
-                        spec, WorkerDiedError("leased worker lost")
-                    )
+                for spec in batch:
+                    with self._lock:
+                        # a streamed result may have landed (and popped the
+                        # inflight record) before the connection died —
+                        # re-running THAT task would double-execute it
+                        still_pending = spec.task_id in self._specs_inflight
+                    if not still_pending:
+                        continue
+                    self._submit_stage[spec.task_id] = "worker_lost"
+                    try:
+                        await self._direct_worker_lost(spec, lease)
+                    except Exception:
+                        logger.exception(
+                            "direct-push loss handling failed for %s",
+                            spec.name,
+                        )
+                        self._fail_returns_exc(
+                            spec, WorkerDiedError("leased worker lost")
+                        )
                 return
+            if results is None:
+                continue  # batch path: results already streamed + processed
             # The spec is consumed from the queue: any failure past this
             # point MUST still resolve the task's returns, or the caller's
             # get() blocks forever on an object nobody will produce.
-            self._submit_stage[spec.task_id] = "resulted"
-            try:
-                await self._direct_result(spec, result)
-            except Exception as e:
-                logger.exception(
-                    "direct result processing failed for %s", spec.name
-                )
-                self._fail_returns(
-                    spec, f"internal error processing task result: {e!r}"
-                )
+            for spec, result in zip(batch, results):
+                self._submit_stage[spec.task_id] = "resulted"
+                try:
+                    await self._direct_result(spec, result)
+                except Exception as e:
+                    logger.exception(
+                        "direct result processing failed for %s", spec.name
+                    )
+                    self._fail_returns(
+                        spec, f"internal error processing task result: {e!r}"
+                    )
 
     # -- direct actor calls --------------------------------------------
     def _actor_direct_enqueue(self, spec: TaskSpec):
@@ -649,6 +688,10 @@ class CoreWorker:
         the wrong call. Recovery waits for every in-flight direct reply to
         settle, then resubmits the failed calls lowest-seq-first ahead of
         anything still queued."""
+        # one tick before draining: under the eager task factory the sender
+        # would otherwise run synchronously inside the FIRST enqueue of a
+        # burst and see a one-deep queue (no batching, one frame per call)
+        await asyncio.sleep(0)
         loop = asyncio.get_running_loop()
         try:
             while st["q"] or st["relost"]:
@@ -689,17 +732,44 @@ class CoreWorker:
                     if conn is None:
                         st["fallback"] = True
                         continue
-                spec = st["q"].popleft()
+                if self._actor_sequential.get(actor_id):
+                    # Strictly sequential actor: a burst may ride ONE
+                    # frame/dispatch without changing call semantics. Cap
+                    # frames in flight so the NEXT burst accumulates into a
+                    # real batch instead of leaving one spec at a time
+                    # (a submitting thread slower than this loop would
+                    # otherwise never see queue depth > 1).
+                    while (st["inflight"] >= cfg.actor_direct_max_inflight
+                           and not st["fallback"]
+                           and st["conn"] is conn and not conn.closed):
+                        st["settled"].clear()
+                        await st["settled"].wait()
+                    if (st["fallback"] or st["conn"] is not conn
+                            or conn.closed):
+                        continue  # re-evaluate route from the loop top
+                    if not st["q"]:
+                        continue
+                    k = min(len(st["q"]), cfg.direct_push_batch_max)
+                    batch = [st["q"].popleft() for _ in range(k)]
+                else:
+                    batch = [st["q"].popleft()]
                 try:
-                    fut = conn.request_nowait("execute_task", {"spec": spec})
+                    if len(batch) == 1:
+                        fut = conn.request_nowait(
+                            "execute_task", {"spec": batch[0]}
+                        )
+                    else:
+                        fut = conn.request_nowait(
+                            "execute_task_batch", {"specs": batch}
+                        )
                 except Exception:
                     st["conn"] = None
                     st["fallback"] = True
-                    st["relost"].append(spec)
+                    st["relost"].extend(batch)
                     continue
                 st["inflight"] += 1
                 self._spawn(
-                    self._actor_direct_reply(actor_id, st, spec, fut)
+                    self._actor_direct_reply(actor_id, st, batch, fut)
                 )
         finally:
             st["running"] = False
@@ -727,12 +797,15 @@ class CoreWorker:
             return None
 
     async def _actor_direct_reply(self, actor_id: bytes, st: dict,
-                                  spec: TaskSpec, fut):
+                                  batch: List[TaskSpec], fut):
         try:
-            result = await fut
+            results = await fut
+            # batch replies are completion acks — the per-call results
+            # streamed back as task_result notifies while the batch ran
+            results = [results] if len(batch) == 1 else None
         except Exception:
             # Worker died / restarting: flip to sticky raylet fallback. The
-            # call was SENT, so its fate is unknown — at-most-once actor
+            # calls were SENT, so their fate is unknown — at-most-once actor
             # semantics (ray: actor tasks are NOT retried unless
             # max_task_retries is set) forbid blind resubmission: a
             # side-effecting call like `die()` would re-execute against the
@@ -740,15 +813,23 @@ class CoreWorker:
             st["fallback"] = True
             if st.get("conn") is not None and st["conn"].closed:
                 st["conn"] = None
-            if spec.attempt < spec.max_retries:
-                spec.attempt += 1
-                st["relost"].append(spec)
-            else:
-                self._fail_returns_exc(spec, ActorDiedError(
-                    f"The actor died while this call was in flight; actor "
-                    f"tasks run at-most-once and are not retried unless "
-                    f"max_task_retries is set (method {spec.name!r})."
-                ))
+            for spec in batch:
+                with self._lock:
+                    # a streamed result may have landed before the failure;
+                    # re-submitting THAT call would break at-most-once
+                    still_pending = spec.task_id in self._specs_inflight
+                if not still_pending:
+                    continue
+                if spec.attempt < spec.max_retries:
+                    spec.attempt += 1
+                    st["relost"].append(spec)
+                else:
+                    self._fail_returns_exc(spec, ActorDiedError(
+                        f"The actor died while this call was in flight; "
+                        f"actor tasks run at-most-once and are not retried "
+                        f"unless max_task_retries is set "
+                        f"(method {spec.name!r})."
+                    ))
             st["inflight"] -= 1
             st["settled"].set()
             if not st["running"]:
@@ -757,15 +838,18 @@ class CoreWorker:
             return
         st["inflight"] -= 1
         st["settled"].set()
-        try:
-            await self._direct_result(spec, result)
-        except Exception as e:
-            logger.exception(
-                "actor-direct result processing failed for %s", spec.name
-            )
-            self._fail_returns(
-                spec, f"internal error processing task result: {e!r}"
-            )
+        if results is None:
+            return  # batch path: results already streamed + processed
+        for spec, result in zip(batch, results):
+            try:
+                await self._direct_result(spec, result)
+            except Exception as e:
+                logger.exception(
+                    "actor-direct result processing failed for %s", spec.name
+                )
+                self._fail_returns(
+                    spec, f"internal error processing task result: {e!r}"
+                )
 
     async def _direct_worker_lost(self, spec: TaskSpec,
                                   lease: Optional[dict] = None):
@@ -935,6 +1019,9 @@ class CoreWorker:
         import cloudpickle
 
         actor_id = ActorID.of(JobID(self.job_id))
+        self._actor_sequential[actor_id.binary()] = (
+            max_concurrency == 1 and not concurrency_groups
+        )
         resources = dict(resources)
         scheduling = scheduling or SchedulingStrategy()
         if scheduling.kind == "PLACEMENT_GROUP":
@@ -1398,22 +1485,103 @@ class CoreWorker:
 
     async def rpc_execute_task(self, conn: Connection, p):
         ex = await self._await_executor()
+        return await self._execute_one(ex, p["spec"],
+                                       direct=conn is not self.raylet)
+
+    async def rpc_execute_task_batch(self, conn: Connection, p):
+        """Batched direct push: N specs in ONE request frame, N result
+        dicts in ONE response (ray parity: the reference batches its task
+        plane at every layer — src/ray/rpc/, task_event_buffer.h:199).
+        Specs run SEQUENTIALLY in arrival order: plain tasks serialize on
+        the single-thread pool anyway, and skipping the per-task dispatch
+        asyncio.Task + request/response frame pair is precisely the
+        per-message event-loop cost this path exists to amortize."""
+        ex = await self._await_executor()
         direct = conn is not self.raylet
+        specs = p["specs"]
+        if direct:
+            for spec in specs:
+                self._emit_direct_task_event(spec, "RUNNING")
+
+        buf: list = []
+        flush_ref: list = [None]
+
+        async def flush_results():
+            # one tick: results completing in the same loop burst share a
+            # task_result_batch frame; a lone (slow) result still flushes
+            # on the next tick — no added latency
+            await asyncio.sleep(0)
+            while buf:
+                chunk, buf[:] = list(buf), []
+                if len(chunk) == 1:
+                    await conn.notify("task_result", chunk[0])
+                else:
+                    await conn.notify("task_result_batch", chunk)
+
+        async def deliver(spec: TaskSpec, result: dict):
+            # Stream each result back the moment it lands (same payload
+            # shape _direct_result builds on the owner) — the batch
+            # RESPONSE is only a completion ack, so ray.wait sees early
+            # tasks while the batch tail still runs.
+            if direct:
+                if result.get("error") is not None:
+                    self._emit_direct_task_event(
+                        spec, "FAILED",
+                        error=str(result.get("error"))[:200],
+                    )
+                else:
+                    self._emit_direct_task_event(
+                        spec, "FINISHED", duration=result.get("duration"),
+                    )
+                if result.get("stored_objects"):
+                    try:
+                        await self.raylet.notify(
+                            "register_stored",
+                            {"object_ids": list(result["stored_objects"])},
+                        )
+                    except Exception:
+                        pass
+            buf.append({
+                "task_id": spec.task_id,
+                "results": result.get("results"),
+                "error": result.get("error"),
+                "error_value": result.get("error_value"),
+                "app_error": result.get("app_error", False),
+                "retriable": result.get("retriable", False),
+                "attempt": spec.attempt,
+                "exec_addr": result.get("exec_addr"),
+                "borrows_kept": result.get("borrows_kept"),
+                "returns_nested": result.get("returns_nested"),
+                "dynamic_return_oids": result.get("dynamic_return_oids"),
+            })
+            t = flush_ref[0]
+            if t is None or t.done():
+                flush_ref[0] = self._spawn(flush_results())
+
+        await ex.execute_task_batch(specs, deliver)
+        t = flush_ref[0]
+        if t is not None:
+            # every result must be on the wire BEFORE the ack: the owner
+            # treats acked batches as fully resulted on conn failure
+            await asyncio.shield(t)
+        return {"done": len(specs)}
+
+    async def _execute_one(self, ex, spec: TaskSpec, direct: bool):
         if direct:
             # the raylet never sees direct-push tasks, so this worker owns
             # their observability record (state API / timeline parity with
             # raylet-routed tasks)
-            self._emit_direct_task_event(p["spec"], "RUNNING")
-        result = await ex.execute_task(p["spec"])
+            self._emit_direct_task_event(spec, "RUNNING")
+        result = await ex.execute_task(spec)
         if direct:
             if result.get("error") is not None:
                 self._emit_direct_task_event(
-                    p["spec"], "FAILED",
+                    spec, "FAILED",
                     error=str(result.get("error"))[:200],
                 )
             else:
                 self._emit_direct_task_event(
-                    p["spec"], "FINISHED", duration=result.get("duration"),
+                    spec, "FINISHED", duration=result.get("duration"),
                 )
             if result.get("stored_objects"):
                 # stored outputs must be self-reported for location tracking
@@ -1445,6 +1613,7 @@ class CoreWorker:
             self._spawn(self._flush_task_events())
 
     async def _flush_task_events(self):
+        await asyncio.sleep(0)  # one tick: coalesce same-burst events
         buf, self._tev_buf = self._tev_buf, []
         self._tev_flushing = False
         if not buf:
@@ -2268,6 +2437,7 @@ class CoreWorker:
                 self._free_flushing = False
 
     async def _flush_frees(self):
+        await asyncio.sleep(0)  # one tick: coalesce same-burst frees
         buf, self._free_buf = self._free_buf, []
         self._free_flushing = False
         if not buf:

@@ -1,6 +1,6 @@
 """ray_tpu.serve — model serving (ray parity: python/ray/serve)."""
 
-from ray_tpu.serve._common import Request
+from ray_tpu.serve._common import Request, Response
 from ray_tpu.serve.api import (
     delete,
     get_app_handle,
@@ -29,6 +29,7 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "Request",
+    "Response",
     "batch",
     "delete",
     "deployment",

@@ -39,12 +39,32 @@ class Request:
     query: Dict[str, str] = field(default_factory=dict)
     headers: Dict[str, str] = field(default_factory=dict)
     body: bytes = b""
+    # route prefix the proxy matched (informs ASGI root_path so a mounted
+    # FastAPI app's routes resolve relative to its deployment route)
+    route_prefix: str = ""
 
     def json(self) -> Any:
         return _json.loads(self.body or b"null")
 
     def text(self) -> str:
         return (self.body or b"").decode()
+
+
+@dataclass
+class Response:
+    """Full HTTP response an ingress handler may return when it needs
+    control over status/headers (ASGI ingress returns these; plain
+    handlers may keep returning bytes/str/JSON-ables). ``headers`` may be
+    a dict or a list of (name, value) pairs — pairs preserve duplicates
+    (multiple Set-Cookie)."""
+
+    status: int = 200
+    headers: Any = field(default_factory=dict)
+    body: bytes = b""
+
+    def header_items(self):
+        return (self.headers.items() if isinstance(self.headers, dict)
+                else list(self.headers or ()))
 
 
 @dataclass

@@ -113,10 +113,37 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
 
 def ingress(app):
-    """ray parity: serve.ingress — pass-through (no ASGI framework glue
-    needed; ingress classes receive serve.Request objects)."""
+    """Mount an ASGI app (FastAPI, Starlette, or any ASGI callable) as the
+    deployment's HTTP handler (ray parity: serve.api.ingress +
+    _private/http_proxy.py:395 ASGI plumbing). The decorated class keeps
+    its own state/methods; HTTP requests route through the app — path
+    params, routers, middleware and lifespan startup hooks all work, so an
+    existing FastAPI application drops in unchanged:
+
+        app = FastAPI()
+
+        @serve.deployment
+        @serve.ingress(app)
+        class Api:
+            ...
+
+    Passing no app (``@serve.ingress`` is not supported — the reference
+    requires the app argument too)."""
 
     def wrap(cls):
-        return cls
+        from ray_tpu.serve.asgi import ASGIAppRunner
+
+        class _ASGIIngress(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self._serve_asgi_runner = ASGIAppRunner(app)
+
+            async def __call__(self, request):
+                return await self._serve_asgi_runner(request)
+
+        _ASGIIngress.__name__ = cls.__name__
+        _ASGIIngress.__qualname__ = cls.__qualname__
+        _ASGIIngress.__module__ = cls.__module__
+        return _ASGIIngress
 
     return wrap

@@ -371,6 +371,7 @@ class HTTPProxy:
             query=dict(request.query),
             headers=dict(request.headers),
             body=body,
+            route_prefix="" if _prefix == "/" else _prefix,
         )
         key = (app_name, ingress)
         handle = self._handles.get(key)
@@ -405,6 +406,20 @@ class HTTPProxy:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         if isinstance(result, dict) and STREAM_MARKER in result:
             return await self._stream_response(request, result[STREAM_MARKER])
+        from ray_tpu.serve._common import Response as ServeResponse
+
+        if isinstance(result, ServeResponse):
+            # full-control response (ASGI ingress): status + headers pass
+            # through — as a multidict so duplicate Set-Cookie survive;
+            # strip hop-by-hop/length headers aiohttp recomputes
+            from multidict import CIMultiDict
+
+            headers = CIMultiDict(
+                (k, v) for k, v in result.header_items()
+                if k.lower() not in ("content-length", "transfer-encoding")
+            )
+            return web.Response(status=result.status, headers=headers,
+                                body=result.body)
         if isinstance(result, bytes):
             return web.Response(body=result)
         if isinstance(result, str):

@@ -14,14 +14,19 @@
 // Build: make -C src   ->  src/librtpu_store.so
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <list>
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <utility>
+#include <vector>
 
+#include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -34,6 +39,147 @@ constexpr uint64_t kHeader = 24;
 
 std::string ObjPath(const std::string& dir, const std::string& oid_hex) {
   return dir + "/" + oid_hex + ".obj";
+}
+
+// --- page-recycling pool (plasma-arena analog) -----------------------------
+// Freshly created tmpfs pages are zeroed + faulted by the kernel, capping a
+// fresh-file put at ~3 GB/s on this class of host; a memcpy into RECYCLED
+// pages runs at memory bandwidth (~11 GB/s measured). Freed objects above
+// kPoolMinBytes therefore move into `<dir>/.pool/` instead of being
+// unlinked; the next writer CLAIMS a best-fit pooled file via rename (atomic
+// on one fs — safe across processes), mmaps and memcpys into the warm
+// pages, truncates to the exact size, and seals via rename as usual.
+// The pool is bounded (kPoolMaxFiles / kPoolMaxBytes) so the recycled pages
+// cost a fixed tmpfs overhead; oversized or surplus frees fall back to
+// unlink. Reference analog: plasma's preallocated arena
+// (src/ray/object_manager/plasma/plasma_allocator.h) achieves the same
+// no-page-fault property by never returning pages to the OS at all.
+constexpr uint64_t kPoolMinBytes = 1ull << 20;    // don't pool small files
+constexpr uint64_t kPoolMaxBytes = 512ull << 20;  // total pooled budget
+constexpr int kPoolMaxFiles = 4;
+
+std::string PoolDir(const std::string& dir) { return dir + "/.pool"; }
+
+// In-process cache of RW mappings of pooled files, keyed by inode (an
+// inode survives every pool<->object rename, so a recycled file's warm
+// mapping keeps working across claims). Re-mapping per claim would pay a
+// soft page fault per 4K page — measured 1.9 GB/s vs ~11 GB/s through a
+// persistent mapping on this host. Bounded at kPoolMaxFiles entries; an
+// entry whose file was unlinked elsewhere just pins its pages until
+// evicted (bounded by kPoolMaxBytes).
+struct PoolMapping {
+  void* addr;
+  uint64_t len;
+  int users;  // writers currently memcpying through this mapping
+};
+std::mutex g_pool_map_mu;
+std::unordered_map<uint64_t, PoolMapping> g_pool_maps;
+
+// Acquire a warm RW mapping for the claimed file; the entry is marked
+// in-use so a concurrent claimer's eviction cannot munmap it mid-memcpy
+// (ctypes releases the GIL across rtpu_write_object, so concurrent
+// writers are real). Pair with PoolMappingRelease(ino).
+uint8_t* PoolMappingAcquire(int fd, uint64_t file_size, uint64_t* ino_out) {
+  struct stat st;
+  if (::fstat(fd, &st) != 0) return nullptr;
+  const uint64_t ino = static_cast<uint64_t>(st.st_ino);
+  std::lock_guard<std::mutex> lock(g_pool_map_mu);
+  auto it = g_pool_maps.find(ino);
+  if (it != g_pool_maps.end() && it->second.len >= file_size) {
+    it->second.users += 1;
+    *ino_out = ino;
+    return static_cast<uint8_t*>(it->second.addr);
+  }
+  if (it != g_pool_maps.end() && it->second.users == 0) {
+    ::munmap(it->second.addr, it->second.len);
+    g_pool_maps.erase(it);
+  } else if (it != g_pool_maps.end()) {
+    return nullptr;  // shorter mapping still in use elsewhere: rare; skip
+  }
+  void* map =
+      ::mmap(nullptr, file_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) return nullptr;
+  if (g_pool_maps.size() >= static_cast<size_t>(kPoolMaxFiles)) {
+    for (auto evict = g_pool_maps.begin(); evict != g_pool_maps.end();
+         ++evict) {
+      if (evict->second.users == 0) {
+        ::munmap(evict->second.addr, evict->second.len);
+        g_pool_maps.erase(evict);
+        break;
+      }
+    }
+  }
+  g_pool_maps[ino] = PoolMapping{map, file_size, 1};
+  *ino_out = ino;
+  return static_cast<uint8_t*>(map);
+}
+
+void PoolMappingRelease(uint64_t ino) {
+  std::lock_guard<std::mutex> lock(g_pool_map_mu);
+  auto it = g_pool_maps.find(ino);
+  if (it != g_pool_maps.end() && it->second.users > 0) {
+    it->second.users -= 1;
+  }
+}
+
+// Move a freed object file into the pool; returns true if pooled (caller
+// skips unlink), false if the pool is full / file out of range.
+bool PoolFreedFile(const std::string& dir, const std::string& obj_path,
+                   uint64_t size) {
+  {
+    // pool files keep their (possibly larger) recycled length: name by
+    // the REAL file size so best-fit claims see usable capacity
+    struct stat st;
+    if (::stat(obj_path.c_str(), &st) == 0) {
+      size = static_cast<uint64_t>(st.st_size);
+    }
+  }
+  if (size < kPoolMinBytes || size > kPoolMaxBytes) return false;
+  const std::string pool = PoolDir(dir);
+  ::mkdir(pool.c_str(), 0755);
+  uint64_t bytes = 0;
+  int files = 0;
+  if (DIR* d = ::opendir(pool.c_str())) {
+    while (dirent* e = ::readdir(d)) {
+      if (e->d_name[0] == '.') continue;
+      struct stat st;
+      if (::stat((pool + "/" + e->d_name).c_str(), &st) == 0) {
+        bytes += static_cast<uint64_t>(st.st_size);
+        ++files;
+      }
+    }
+    ::closedir(d);
+  }
+  if (files >= kPoolMaxFiles || bytes + size > kPoolMaxBytes) return false;
+  // name carries the size for cheap best-fit scans; pid+address uniquify
+  static std::atomic<uint64_t> seq{0};
+  const std::string dst = pool + "/" + std::to_string(size) + "-" +
+                          std::to_string(::getpid()) + "-" +
+                          std::to_string(seq.fetch_add(1)) + ".pool";
+  return ::rename(obj_path.c_str(), dst.c_str()) == 0;
+}
+
+// Claim the best-fit pooled file with st_size >= total: rename it to
+// `claim_path` (atomic claim; a lost race just tries the next candidate).
+// Returns the claimed file's size, or 0 when nothing fits.
+uint64_t ClaimPooledFile(const std::string& dir, uint64_t total,
+                         const std::string& claim_path) {
+  const std::string pool = PoolDir(dir);
+  DIR* d = ::opendir(pool.c_str());
+  if (d == nullptr) return 0;
+  // collect candidates sorted by size (pool is <= kPoolMaxFiles entries)
+  std::vector<std::pair<uint64_t, std::string>> fits;
+  while (dirent* e = ::readdir(d)) {
+    if (e->d_name[0] == '.') continue;
+    const uint64_t size = ::strtoull(e->d_name, nullptr, 10);
+    if (size >= total) fits.emplace_back(size, pool + "/" + e->d_name);
+  }
+  ::closedir(d);
+  std::sort(fits.begin(), fits.end());
+  for (const auto& [size, path] : fits) {
+    if (::rename(path.c_str(), claim_path.c_str()) == 0) return size;
+  }
+  return 0;
 }
 
 // One mapped, sealed object handed out to a reader.
@@ -66,9 +212,45 @@ long rtpu_write_object(const char* store_dir, const char* oid_hex,
 
   const std::string tmp =
       final_path + ".building." + std::to_string(::getpid());
+
+  // Fast path: memcpy into a recycled file's already-faulted pages
+  // through a persistent (inode-keyed) mapping — ~11 GB/s vs ~3 GB/s for
+  // the fresh-page write() below. The file keeps its pooled length (the
+  // header records the true lengths; readers ignore trailing slack), so
+  // the warm mapping stays valid for the next recycle.
+  if (total >= kPoolMinBytes) {
+    if (const uint64_t pooled = ClaimPooledFile(store_dir, total, tmp)) {
+      int fd = ::open(tmp.c_str(), O_RDWR);
+      if (fd >= 0) {
+        uint64_t ino = 0;
+        uint8_t* p = PoolMappingAcquire(fd, pooled, &ino);
+        ::close(fd);  // the cached mapping keeps the inode alive
+        if (p != nullptr) {
+          std::memcpy(p, kMagic, 8);
+          std::memcpy(p + 8, &meta_len, 8);
+          std::memcpy(p + 16, &data_len, 8);
+          p += kHeader;
+          std::memcpy(p, metadata, meta_len);
+          p += meta_len;
+          for (uint64_t i = 0; i < nbufs; ++i) {
+            std::memcpy(p, bufs[i], buf_lens[i]);
+            p += buf_lens[i];
+          }
+          PoolMappingRelease(ino);
+          if (::rename(tmp.c_str(), final_path.c_str()) == 0) {
+            return static_cast<long>(total);
+          }
+          ::unlink(tmp.c_str());
+          return -1;
+        }
+      }
+      ::unlink(tmp.c_str());  // claimed but unusable: drop, fall through
+    }
+  }
+
   int fd = ::open(tmp.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
   if (fd < 0) return -1;
-  // write() instead of ftruncate+mmap+memcpy: filling fresh tmpfs pages
+  // write() instead of ftruncate+mmap+memcpy: filling FRESH tmpfs pages
   // through a mapping pays a page fault + kernel zeroing per page
   // (~1.3 GB/s measured on this host); full-page write() skips the
   // zeroing and the faults (~3 GB/s).
@@ -219,7 +401,18 @@ struct RtpuStore {
     }
     auto found = objects.find(oid);
     if (found == objects.end()) return;
-    ::unlink(ObjPath(dir, oid).c_str());
+    const std::string path = ObjPath(dir, oid);
+    // Recycling rewrites the file's pages in place, so only an object no
+    // internal protocol still holds may be pooled: pinned entries
+    // (mid-transfer/spill, borrower handoff) must keep immutable pages —
+    // plain unlink leaves the inode intact for live mappings. (Reader
+    // views kept alive past all refs see recycled pages change — same
+    // undefined behavior as the reference's plasma memory reuse at
+    // refcount zero.)
+    if (found->second.pins > 0 ||
+        !PoolFreedFile(dir, path, found->second.size)) {
+      ::unlink(path.c_str());
+    }
     used -= found->second.size;
     lru.erase(found->second.it);
     objects.erase(found);
