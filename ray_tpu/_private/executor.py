@@ -172,6 +172,7 @@ class TaskExecutor:
         dominant worker-side cost for short calls); each completion still
         streams out of the run individually, so a slow task inside a run
         delays nobody behind it being DELIVERED, only executed."""
+        pending = []
         i, n = 0, len(specs)
         while i < n:
             if self._batchable(specs[i]):
@@ -182,9 +183,20 @@ class TaskExecutor:
                     k += 1
                 await self._execute_sync_run(specs[i:i + k], deliver)
             else:
+                # Non-batchable (async functions, dynamic returns, traced):
+                # dispatch CONCURRENTLY, exactly as separate execute_task
+                # requests would have — awaiting inline would serialize
+                # async tasks and deadlock co-batched tasks that
+                # coordinate with each other.
                 k = 1
-                await deliver(specs[i], await self.execute_task(specs[i]))
+
+                async def run_one(s=specs[i]):
+                    await deliver(s, await self.execute_task(s))
+
+                pending.append(asyncio.ensure_future(run_one()))
             i += k
+        for t in pending:
+            await t
 
     async def _execute_sync_run(self, specs, deliver):
         """Run a contiguous burst of batchable calls in one pool hop,

@@ -64,11 +64,28 @@ def _obj_path(store_dir: str, object_id: ObjectID) -> str:
 
 
 def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
-    """Open and mmap a sealed object. Returns None if absent. Any process."""
+    """Open and mmap a sealed object. Returns None if absent. Any process.
+
+    Readers hold a SHARED flock on the file for the buffer's lifetime —
+    the free path's page-recycling pool takes a non-blocking EXCLUSIVE
+    flock before recycling, so pages a live zero-copy view still maps can
+    never be rewritten; the pool falls back to unlink (inode stays intact
+    for existing mappings). The post-lock inode recheck closes the
+    open->lock race against a concurrent pool rename."""
+    import fcntl
+
     path = _obj_path(store_dir, object_id)
     try:
         f = open(path, "rb")
     except FileNotFoundError:
+        return None
+    try:
+        fcntl.flock(f.fileno(), fcntl.LOCK_SH)
+        if os.fstat(f.fileno()).st_ino != os.stat(path).st_ino:
+            f.close()  # pooled/recycled between open and lock: gone
+            return None
+    except OSError:
+        f.close()
         return None
     m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     if m[:8] != _MAGIC:

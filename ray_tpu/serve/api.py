@@ -43,17 +43,31 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
 
 
-def start(http_options: Optional[Dict[str, Any]] = None, **_kw):
-    """ray parity: serve.start — ensure controller + HTTP proxy."""
+def start(http_options: Optional[Dict[str, Any]] = None,
+          grpc_options: Optional[Dict[str, Any]] = None, **_kw):
+    """ray parity: serve.start — ensure controller + proxy fleet.
+
+    ``grpc_options``: {"grpc_servicer_functions": [...]} — import paths
+    (or callables) of protoc-generated ``add_XServicer_to_server``
+    functions; the proxies register them so clients call typed stubs
+    (ray parity: serve.config.gRPCOptions)."""
     import ray_tpu
 
     global _http_port
     http_options = http_options or {}
+    servicers = []
+    for fn in (grpc_options or {}).get("grpc_servicer_functions", ()):
+        if callable(fn):
+            # cross the actor boundary as an import path: the proxy
+            # re-imports the generated module in its own process
+            fn = f"{fn.__module__}:{fn.__qualname__}"
+        servicers.append(fn)
     controller = _get_or_create_controller()
     _http_port = ray_tpu.get(
         controller.ensure_proxy.remote(
             http_options.get("host", "127.0.0.1"),
             http_options.get("port", 8000),
+            servicers,
         ),
         timeout=90,
     )

@@ -435,14 +435,17 @@ class ServeController:
     # proxy fleet management (ray parity: serve/_private/proxy_state.py
     # ProxyStateManager — one ProxyActor per alive node, HTTP + gRPC)
     # ------------------------------------------------------------------
-    def ensure_proxy(self, host: str, port: int) -> int:
+    def ensure_proxy(self, host: str, port: int,
+                     grpc_servicer_functions=None) -> int:
         """Start (or reconcile) one proxy per alive node; returns the
         head/first proxy's HTTP port for serve.start compat."""
         import ray_tpu
 
         with self._lock:
             started = self._proxy_cfg is not None
-            self._proxy_cfg = {"host": host, "port": port}
+            self._proxy_cfg = {"host": host, "port": port,
+                               "grpc_servicer_functions":
+                               list(grpc_servicer_functions or ())}
             if started and self._proxies:
                 # fast path: the control loop maintains the fleet; don't
                 # make every serve.run pay a full reconcile pass
@@ -545,7 +548,12 @@ class ServeController:
                             node_id=nid, soft=False
                         ),
                     )(HTTPProxy)
-                    handle = proxy_cls.remote(cfg["host"], cfg["port"])
+                    handle = proxy_cls.remote(
+                        cfg["host"], cfg["port"],
+                        grpc_servicer_functions=cfg.get(
+                            "grpc_servicer_functions"
+                        ),
+                    )
                 except ValueError:
                     # name taken: an earlier pass (or a controller
                     # restart) already created it — adopt it

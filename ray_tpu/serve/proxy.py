@@ -14,14 +14,47 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 from typing import Dict, Optional, Tuple
 
 from ray_tpu.serve._common import ROUTES_PUSH_CHANNEL, Request
 
+logger = logging.getLogger(__name__)
+
 # with push in place the poll is only a safety net
 _ROUTE_POLL_TTL_S = 10.0
 _ROUTE_POLL_TTL_UNPUSHED_S = 1.0
+
+
+class _ForwardingServicer:
+    """Stands in for the user's real servicer when a generated
+    ``add_XServicer_to_server`` registers methods (ray parity: the
+    DummyServicer in serve/_private/grpc_util.py): every method the
+    generated code looks up resolves to a forwarder that routes the typed
+    request through serve's handle plane."""
+
+    def __init__(self, proxy: "HTTPProxy"):
+        self._proxy = proxy
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        proxy = self._proxy
+
+        def forward(request, context):
+            import grpc
+
+            meta = dict(context.invocation_metadata() or ())
+            try:
+                return proxy._grpc_invoke_typed(meta, method_name, request)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        return forward
 
 
 class HTTPProxy:
@@ -31,11 +64,13 @@ class HTTPProxy:
     for gRPC, sharing one routing table and handle cache."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 grpc_port: Optional[int] = 0):
+                 grpc_port: Optional[int] = 0,
+                 grpc_servicer_functions: Optional[list] = None):
         import concurrent.futures
 
         self._host = host
         self._port = port
+        self._grpc_servicer_functions = list(grpc_servicer_functions or ())
         self._actual_port: Optional[int] = None
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._routes_fetched_at = 0.0
@@ -86,6 +121,12 @@ class HTTPProxy:
 
         class _Handler(grpc.GenericRpcHandler):
             def service(self, hcd):
+                # claim ONLY the generic ingress service; returning None
+                # lets gRPC fall through to the typed servicers the user
+                # registered via grpc_servicer_functions
+                if not hcd.method.startswith("/ray_tpu.serve.Ingress/"):
+                    return None
+
                 def unary(request_bytes, context):
                     meta = dict(context.invocation_metadata() or ())
                     try:
@@ -126,6 +167,22 @@ class HTTPProxy:
             max_workers=64, thread_name_prefix="serve-grpc"
         ))
         server.add_generic_rpc_handlers((_Handler(),))
+        # Typed servicers (ray parity: gRPCOptions.grpc_servicer_functions
+        # + the DummyServicer in serve/_private/grpc_util.py): each entry
+        # is a protoc-generated ``add_XServicer_to_server`` function (or
+        # its "module:attr" import path). It registers REAL method
+        # handlers with the generated proto (de)serializers around a
+        # forwarding servicer, so clients use their generated stubs and
+        # replicas receive/return actual proto messages; the RPC method
+        # name selects the deployment method of the same name.
+        for entry in self._grpc_servicer_functions:
+            try:
+                add_fn = self._resolve_servicer_fn(entry)
+                add_fn(_ForwardingServicer(self), server)
+            except Exception:
+                logger.exception(
+                    "failed to register gRPC servicer %r", entry
+                )
         bound = server.add_insecure_port(f"{host}:{port}")
         if bound == 0 and port != 0:
             bound = server.add_insecure_port(f"{host}:0")
@@ -133,15 +190,25 @@ class HTTPProxy:
         self._grpc_server = server
         self._grpc_actual_port = bound
 
-    def _grpc_invoke(self, meta: dict, request_bytes: bytes):
-        """Shared routing + invocation for both gRPC shapes: returns the
-        RAW handler result (a stream-marker dict for generators)."""
-        import pickle
+    @staticmethod
+    def _resolve_servicer_fn(entry):
+        """A servicer entry is a callable or a 'module:attr' /
+        'module.attr' import path (entries cross actor boundaries as
+        strings, like the reference's grpc_servicer_functions)."""
+        if callable(entry):
+            return entry
+        import importlib
 
-        import ray_tpu
+        s = str(entry)
+        if ":" in s:
+            mod, attr = s.split(":", 1)
+        else:
+            mod, _, attr = s.rpartition(".")
+        return getattr(importlib.import_module(mod), attr)
 
-        # route: "application" metadata first, else the app at "/"
-        app_name = meta.get("application")
+    def _grpc_route(self, app_name: Optional[str]):
+        """Resolve the target (app, ingress) handle: "application"
+        metadata first, else the app mounted at "/"."""
 
         def find_target():
             if app_name:
@@ -167,6 +234,53 @@ class HTTPProxy:
 
             handle = DeploymentHandle(target[1], target[0])
             self._handles[target] = handle
+        return handle
+
+    def _grpc_invoke_typed(self, meta: dict, method_name: str, request):
+        """Typed servicer path: the deserialized proto message goes to the
+        deployment method NAMED LIKE THE RPC; the return value (a response
+        proto) serializes back through the generated serializer. Generator
+        deployments surface as an iterator of protos (server streaming)."""
+        import ray_tpu
+        from ray_tpu.serve.replica import STREAM_MARKER
+
+        handle = self._grpc_route(meta.get("application"))
+        h = getattr(handle, method_name)
+        result = ray_tpu.get(h.remote(request).ref, timeout=60)
+        if isinstance(result, dict) and STREAM_MARKER in result:
+            return self._iter_stream_items(result[STREAM_MARKER])
+        return result
+
+    def _iter_stream_items(self, info: dict):
+        """Yield a generator deployment's items as-is (typed gRPC
+        streaming: each yielded item is already a response proto)."""
+        import ray_tpu
+
+        replica = ray_tpu.get_actor(info["replica"])
+        sid = info["stream_id"]
+        try:
+            while True:
+                items, done = ray_tpu.get(
+                    replica.next_chunks.remote(sid), timeout=60
+                )
+                yield from items
+                if done:
+                    return
+        except BaseException:
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+            raise
+
+    def _grpc_invoke(self, meta: dict, request_bytes: bytes):
+        """Shared routing + invocation for both gRPC shapes: returns the
+        RAW handler result (a stream-marker dict for generators)."""
+        import pickle
+
+        import ray_tpu
+
+        handle = self._grpc_route(meta.get("application"))
         try:
             payload = pickle.loads(request_bytes)
         except Exception:
@@ -209,46 +323,11 @@ class HTTPProxy:
         if not (isinstance(result, dict) and STREAM_MARKER in result):
             yield pickle.dumps(result)
             return
-        info = result[STREAM_MARKER]
-        replica = ray_tpu.get_actor(info["replica"])
-        sid = info["stream_id"]
-        try:
-            while True:
-                items, done = ray_tpu.get(
-                    replica.next_chunks.remote(sid), timeout=60
-                )
-                for item in items:
-                    yield pickle.dumps(item)
-                if done:
-                    return
-        except BaseException:
-            # client hung up / replica died: stop the producer
-            try:
-                replica.cancel_stream.remote(sid)
-            except Exception:
-                pass
-            raise
+        for item in self._iter_stream_items(result[STREAM_MARKER]):
+            yield pickle.dumps(item)
 
     def _drain_stream(self, info: dict):
-        import ray_tpu
-
-        replica = ray_tpu.get_actor(info["replica"])
-        sid = info["stream_id"]
-        out = []
-        try:
-            while True:
-                items, done = ray_tpu.get(
-                    replica.next_chunks.remote(sid), timeout=60
-                )
-                out.extend(items)
-                if done:
-                    break
-        except Exception:
-            try:
-                replica.cancel_stream.remote(sid)
-            except Exception:
-                pass
-            raise
+        out = list(self._iter_stream_items(info))
         if out and all(isinstance(i, bytes) for i in out):
             return b"".join(out)
         if out and all(isinstance(i, str) for i in out):

@@ -29,6 +29,7 @@
 #include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
+#include <sys/file.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -151,12 +152,24 @@ bool PoolFreedFile(const std::string& dir, const std::string& obj_path,
     ::closedir(d);
   }
   if (files >= kPoolMaxFiles || bytes + size > kPoolMaxBytes) return false;
+  // A live zero-copy reader holds a SHARED flock on the file for its
+  // mapping's lifetime; recycling would rewrite the pages under it. Only
+  // pool when the EXCLUSIVE lock is free — otherwise the caller unlinks,
+  // which keeps the inode (and the reader's view) intact forever.
+  int fd = ::open(obj_path.c_str(), O_RDWR);
+  if (fd < 0) return false;
+  if (::flock(fd, LOCK_EX | LOCK_NB) != 0) {
+    ::close(fd);
+    return false;
+  }
   // name carries the size for cheap best-fit scans; pid+address uniquify
   static std::atomic<uint64_t> seq{0};
   const std::string dst = pool + "/" + std::to_string(size) + "-" +
                           std::to_string(::getpid()) + "-" +
                           std::to_string(seq.fetch_add(1)) + ".pool";
-  return ::rename(obj_path.c_str(), dst.c_str()) == 0;
+  const bool ok = ::rename(obj_path.c_str(), dst.c_str()) == 0;
+  ::close(fd);  // releases the lock; the file is out of readers' reach now
+  return ok;
 }
 
 // Claim the best-fit pooled file with st_size >= total: rename it to
@@ -169,10 +182,17 @@ uint64_t ClaimPooledFile(const std::string& dir, uint64_t total,
   if (d == nullptr) return 0;
   // collect candidates sorted by size (pool is <= kPoolMaxFiles entries)
   std::vector<std::pair<uint64_t, std::string>> fits;
+  // slack cap: a claimed file keeps its full length for mapping reuse, so
+  // letting a 1MB object claim a 400MB file would carry the slack as
+  // invisible tmpfs footprint for the object's lifetime; 2x bounds the
+  // worst-case shm overshoot at 2x live bytes
+  const uint64_t max_size = total * 2;
   while (dirent* e = ::readdir(d)) {
     if (e->d_name[0] == '.') continue;
     const uint64_t size = ::strtoull(e->d_name, nullptr, 10);
-    if (size >= total) fits.emplace_back(size, pool + "/" + e->d_name);
+    if (size >= total && size <= max_size) {
+      fits.emplace_back(size, pool + "/" + e->d_name);
+    }
   }
   ::closedir(d);
   std::sort(fits.begin(), fits.end());
@@ -182,10 +202,14 @@ uint64_t ClaimPooledFile(const std::string& dir, uint64_t total,
   return 0;
 }
 
-// One mapped, sealed object handed out to a reader.
+// One mapped, sealed object handed out to a reader. The fd stays open
+// holding a SHARED flock for the mapping's lifetime: the recycling pool
+// only rewrites pages of files it can take an EXCLUSIVE flock on, so a
+// live reader's view is never recycled under it.
 struct MappedObject {
   void* base = nullptr;
   uint64_t size = 0;
+  int fd = -1;
 };
 
 }  // namespace
@@ -294,13 +318,22 @@ void* rtpu_open_object(const char* store_dir, const char* oid_hex,
   int fd = ::open(path.c_str(), O_RDONLY);
   if (fd < 0) return nullptr;
   struct stat st;
-  if (::fstat(fd, &st) != 0 || st.st_size < (off_t)kHeader) {
+  // SHARED lock for the mapping's lifetime (fends off page recycling);
+  // the inode recheck closes the open->lock race against a concurrent
+  // pool rename — a recycled file is simply "absent".
+  struct stat pst;
+  if (::flock(fd, LOCK_SH) != 0 ||
+      ::stat(path.c_str(), &pst) != 0 ||
+      ::fstat(fd, &st) != 0 || st.st_ino != pst.st_ino ||
+      st.st_size < (off_t)kHeader) {
     ::close(fd);
     return nullptr;
   }
   void* map = ::mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
-  ::close(fd);  // mapping keeps the inode alive
-  if (map == MAP_FAILED) return nullptr;
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
   const uint8_t* p = static_cast<const uint8_t*>(map);
   if (std::memcmp(p, kMagic, 8) != 0) {
     ::munmap(map, st.st_size);
@@ -317,7 +350,8 @@ void* rtpu_open_object(const char* store_dir, const char* oid_hex,
   *meta_len = mlen;
   *data_ptr = p + kHeader + mlen;
   *data_len = dlen;
-  auto* handle = new MappedObject{map, static_cast<uint64_t>(st.st_size)};
+  auto* handle =
+      new MappedObject{map, static_cast<uint64_t>(st.st_size), fd};
   return handle;
 }
 
@@ -325,6 +359,7 @@ void rtpu_release_object(void* handle) {
   auto* h = static_cast<MappedObject*>(handle);
   if (h == nullptr) return;
   ::munmap(h->base, h->size);
+  if (h->fd >= 0) ::close(h->fd);  // drops the reader's shared flock
   delete h;
 }
 

@@ -1,0 +1,65 @@
+"""Typed gRPC ingress: user servicer registration via generated-style
+``add_XServicer_to_server`` functions (ray parity:
+serve.config.gRPCOptions.grpc_servicer_functions + the DummyServicer in
+serve/_private/grpc_util.py). Clients call typed stubs with proto
+(de)serializers; deployments receive/return message objects."""
+
+import grpc
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from tests.serve_test_app import TextReply, TextRequest, text_app
+
+
+@pytest.fixture
+def typed_serve(ray_start_regular):
+    serve.start(grpc_options={
+        "grpc_servicer_functions": [
+            "tests.serve_test_app:add_TextServicer_to_server",
+        ],
+    })
+    serve.run(text_app, name="textapp", route_prefix="/")
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    proxies = ray_tpu.get(controller.get_proxies.remote(), timeout=30)
+    port = next(iter(proxies.values()))["grpc_port"]
+    assert port, "gRPC proxy did not start"
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield channel
+    channel.close()
+    serve.shutdown()
+
+
+def test_typed_unary_call(typed_serve):
+    stub = typed_serve.unary_unary(
+        "/test.TextService/Upper",
+        request_serializer=lambda r: r.SerializeToString(),
+        response_deserializer=TextReply.FromString,
+    )
+    reply = stub(TextRequest("hello"), timeout=60,
+                 metadata=(("application", "textapp"),))
+    assert reply.text == "HELLO"
+    assert reply.length == 5
+
+
+def test_typed_server_streaming(typed_serve):
+    stub = typed_serve.unary_stream(
+        "/test.TextService/Spell",
+        request_serializer=lambda r: r.SerializeToString(),
+        response_deserializer=TextReply.FromString,
+    )
+    out = [r.text for r in stub(TextRequest("abc"), timeout=60,
+                                metadata=(("application", "textapp"),))]
+    assert out == ["a", "b", "c"]
+
+
+def test_typed_unknown_app_not_found(typed_serve):
+    stub = typed_serve.unary_unary(
+        "/test.TextService/Upper",
+        request_serializer=lambda r: r.SerializeToString(),
+        response_deserializer=TextReply.FromString,
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        stub(TextRequest("x"), timeout=30,
+             metadata=(("application", "nope"),))
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
