@@ -122,6 +122,28 @@ def cmd_stop(args):
     print(f"stopped {killed} processes")
 
 
+def cmd_up(args):
+    """ray parity: `ray up cluster.yaml` (autoscaler/_private/commands.py
+    create_or_update_cluster) — TPU-first: workers are slices via a
+    NodeProvider, no SSH updaters."""
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+
+    create_or_update_cluster(args.config, no_monitor=args.no_monitor)
+
+
+def cmd_down(args):
+    """ray parity: `ray down cluster.yaml`."""
+    from ray_tpu.autoscaler.commands import teardown_cluster
+
+    teardown_cluster(args.config)
+
+
+def cmd_cluster_status(args):
+    from ray_tpu.autoscaler.commands import cluster_status
+
+    cluster_status(args.config)
+
+
 def cmd_status(args):
     import ray_tpu
 
@@ -370,6 +392,23 @@ def main(argv=None):
 
     p = sub.add_parser("stop", help="stop the local cluster")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser(
+        "up", help="start (or reconcile) a cluster from a YAML config"
+    )
+    p.add_argument("config", help="cluster YAML (see autoscaler/commands.py)")
+    p.add_argument("--no-monitor", action="store_true",
+                   help="start the head only; skip the autoscaler monitor")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a YAML-launched cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("cluster-status",
+                       help="status of a YAML-launched cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_cluster_status)
 
     p = sub.add_parser("status", help="show cluster nodes + resources")
     p.add_argument("--address")

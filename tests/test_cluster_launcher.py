@@ -1,0 +1,113 @@
+"""Cluster launcher e2e (ray parity: `ray up/down cluster.yaml`,
+autoscaler/_private/commands.py): a YAML with a head + one fake v5e
+slice comes up (head + monitor processes, worker raylet launched by the
+FakeTpuPodProvider to satisfy min_workers), status shows both nodes,
+down tears everything down."""
+
+import os
+import time
+
+import pytest
+import yaml
+
+from ray_tpu.autoscaler import commands
+from ray_tpu.autoscaler.commands import (
+    ClusterConfigError,
+    validate_config,
+)
+
+
+def _base_cfg(name):
+    return {
+        "cluster_name": name,
+        "provider": {"type": "fake_tpu_pod"},
+        "head_node": {"resources": {"CPU": 2}},
+        "available_node_types": {
+            "v5e_4": {
+                "resources": {"TPU": 4, "CPU": 2},
+                "min_workers": 1,
+                "max_workers": 2,
+            },
+        },
+    }
+
+
+def test_validate_config_rejects_bad_shapes():
+    with pytest.raises(ClusterConfigError, match="cluster_name"):
+        validate_config({"provider": {"type": "mock"}})
+    with pytest.raises(ClusterConfigError, match="provider.type"):
+        validate_config({"cluster_name": "x", "provider": {}})
+    with pytest.raises(ClusterConfigError, match="unknown provider.type"):
+        validate_config({"cluster_name": "x",
+                         "provider": {"type": "aws"}})
+    with pytest.raises(ClusterConfigError, match="resources"):
+        validate_config({"cluster_name": "x",
+                         "provider": {"type": "mock"},
+                         "available_node_types": {"t": {}}})
+    with pytest.raises(ClusterConfigError, match="min_workers"):
+        validate_config({"cluster_name": "x",
+                         "provider": {"type": "mock"},
+                         "available_node_types": {
+                             "t": {"resources": {"CPU": 1},
+                                   "min_workers": 3, "max_workers": 1}}})
+    with pytest.raises(ClusterConfigError, match="project"):
+        validate_config({"cluster_name": "x",
+                         "provider": {"type": "tpu_pod"}})
+    validate_config(_base_cfg("ok"))
+
+
+@pytest.fixture
+def launcher_env(tmp_path, monkeypatch):
+    monkeypatch.setattr(commands, "_STATE_DIR", str(tmp_path / "clusters"))
+    cfg = _base_cfg("launchertest")
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    yield str(path)
+    # belt-and-braces teardown if the test failed mid-way
+    try:
+        commands.teardown_cluster(str(path))
+    except Exception:
+        pass
+
+
+def test_up_status_down_end_to_end(launcher_env):
+    path = launcher_env
+    state = commands.create_or_update_cluster(path)
+    assert commands._pid_alive(state["head_pid"])
+    assert commands._pid_alive(state["monitor_pid"])
+
+    # idempotent re-up: same head adopted, no second monitor
+    state2 = commands.create_or_update_cluster(path)
+    assert state2["head_pid"] == state["head_pid"]
+    assert state2["monitor_pid"] == state["monitor_pid"]
+
+    # the monitor's first passes must launch the min_workers=1 fake slice;
+    # status then shows head + worker with the slice's TPU resources
+    deadline = time.time() + 90
+    seen = []
+    while time.time() < deadline:
+        out = commands.cluster_status(path)
+        seen = [n for n in out["nodes"] if n.get("alive", True)]
+        if len(seen) >= 2:
+            break
+        time.sleep(2)
+    assert len(seen) >= 2, f"worker slice never joined: {seen}"
+    tpu_nodes = [
+        n for n in seen
+        if (n.get("resources_total") or {}).get("TPU", 0) >= 4
+    ]
+    assert tpu_nodes, f"no TPU slice node in {seen}"
+    assert any(
+        (n.get("labels") or {}).get("tpu-slice") == "v5e_4"
+        for n in tpu_nodes
+    )
+
+    head_pids = list(state["head_pids"])
+    mpid = state["monitor_pid"]
+    commands.teardown_cluster(path)
+    assert not commands._pid_alive(mpid)
+    for pid in head_pids:
+        assert not commands._pid_alive(pid)
+    # state file dropped: status reports not-started
+    out = commands.cluster_status(path)
+    assert out["up"] is False
