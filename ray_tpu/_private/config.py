@@ -86,7 +86,10 @@ _flag("fetch_warn_timeout_s", float, 10.0)
 # Pull admission + spilling (ray: pull_manager.h:56, local_object_manager.h:40)
 _flag("max_concurrent_pulls", int, 8)
 _flag("pull_manager_memory_fraction", float, 0.5)
-_flag("object_spill_dir", str, "")
+_flag("object_spill_dir", str, "")  # path or storage URI (file://, s3://, ...)
+# module imported by the raylet before building its store — the hook for
+# register_external_storage_scheme plugins (custom spill backends)
+_flag("external_storage_setup_module", str, "")
 # Health / fault tolerance
 _flag("heartbeat_interval_s", float, 0.5)
 _flag("node_death_timeout_s", float, 10.0)

@@ -274,12 +274,73 @@ class SqliteStore:
                 pass
 
 
+class RemoteKvStore:
+    """GCS persistence against a REMOTE KV server (``kv://host:port`` —
+    see kv_server.py). Reference parity: ray's Redis store client
+    (src/ray/gcs/store_client/redis_store_client.h): cluster metadata
+    lives OFF the head node, so losing the head's disk loses nothing —
+    a restarted GCS loads the full snapshot back over the wire.
+
+    Puts are pipelined notifies on one ordered connection (the wire
+    order is the mutation order); ``close`` drains the pipe. Durability
+    window = in-flight notifies, the same posture as Redis pipelining.
+    """
+
+    def __init__(self, address: str, cluster_id: Optional[str] = None):
+        from ray_tpu._private.rpcio import EventLoopThread, connect
+
+        self.cluster_id = cluster_id or ""
+        # kv://[:token@]host:port — the KV server is cluster-EXTERNAL, so
+        # it authenticates with its own secret (redis requirepass shape),
+        # not the per-cluster generated token
+        token = None
+        if "@" in address:
+            userinfo, address = address.rsplit("@", 1)
+            token = userinfo.lstrip(":")
+        host, port = address.rsplit(":", 1)
+        self._io = EventLoopThread("gcs-kv-store")
+        self._conn = self._io.run(connect(host, int(port),
+                                          name="gcs-kv-store",
+                                          token=token))
+        # fail fast on a wrong address instead of at first load
+        self._io.run(self._conn.request("kv_ping", {}), timeout=10)
+
+    def load(self) -> Dict[str, dict]:
+        out = self._io.run(
+            self._conn.request("kv_load", {"cluster_id": self.cluster_id}),
+            timeout=60,
+        )
+        return out.get("tables", {})
+
+    def put(self, table: str, key, value) -> None:
+        async def _send():
+            await self._conn.notify("kv_put", {
+                "cluster_id": self.cluster_id,
+                "entries": [(table, key, value)],
+            })
+
+        try:
+            self._io.call_soon(_send())
+        except RuntimeError:
+            pass  # shutdown race: the loop is gone
+
+    def close(self) -> None:
+        try:
+            # a request after the notify pipeline proves the pipe drained
+            self._io.run(self._conn.request("kv_ping", {}), timeout=10)
+        except Exception:
+            pass
+        self._io.stop()
+
+
 def make_store(persist_path: Optional[str],
                cluster_id: Optional[str] = None):
     """Backend selection by scheme:
 
     - ``None``/empty        -> NullStore (in-memory, nothing survives)
     - ``sqlite://<path>``   -> SqliteStore (durable external store)
+    - ``kv://host:port``    -> RemoteKvStore (external KV server; head
+      disk loss loses no metadata — kv_server.py, redis-analog)
     - plain path            -> native C++ log store when the library
       loads, Python append-log fallback otherwise
 
@@ -294,6 +355,9 @@ def make_store(persist_path: Optional[str],
     if persist_path.startswith("sqlite://"):
         return SqliteStore(persist_path[len("sqlite://"):],
                            cluster_id=cluster_id)
+    if persist_path.startswith("kv://"):
+        return RemoteKvStore(persist_path[len("kv://"):],
+                             cluster_id=cluster_id)
     try:
         from ray_tpu._private import native_store
 

@@ -143,13 +143,21 @@ def make_local_store(store_dir: str, capacity_bytes: int,
     """Owner-side store factory: native C++ store (src/librtpu_store.so)
     when loadable, else the pure-Python implementation. Both share the
     same on-disk format, so mixed clusters interoperate. ``spill_dir``
-    (on real disk, not /dev/shm) enables spill-to-disk under memory
-    pressure (ray: local_object_manager.h:40)."""
+    is a path OR a storage URI (ray: local_object_manager.h:40 +
+    external_storage.py): file:///bare paths spill to disk — the native
+    store's in-C++ fast path; other schemes (s3://, test-registered)
+    route through the Python store's pluggable driver."""
     from ray_tpu._private import native_store
+    from ray_tpu._private.external_storage import is_local_spill_uri
 
-    if native_store.available():
+    if native_store.available() and is_local_spill_uri(spill_dir):
+        from urllib.parse import urlparse
+
+        local = urlparse(spill_dir).path if (
+            spill_dir and spill_dir.startswith("file://")
+        ) else spill_dir
         return native_store.NativeLocalObjectStore(
-            store_dir, capacity_bytes, spill_dir
+            store_dir, capacity_bytes, local
         )
     return LocalObjectStore(store_dir, capacity_bytes, spill_dir)
 
@@ -169,14 +177,22 @@ class LocalObjectStore:
         os.makedirs(store_dir, exist_ok=True)
         self.capacity = capacity_bytes
         self.spill_dir = spill_dir
-        if spill_dir:
-            os.makedirs(spill_dir, exist_ok=True)
+        # URI-pluggable spill backend (ray parity: external_storage.py);
+        # a bare path / file:// is the classic spill-to-disk
+        from ray_tpu._private.external_storage import make_external_storage
+
+        self._external = make_external_storage(spill_dir)
         self._lock = threading.Lock()
         self._sizes: Dict[ObjectID, int] = {}
         self._lru: "OrderedDict[ObjectID, float]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._used = 0
         self._spilled: Dict[ObjectID, int] = {}  # oid -> size on disk
+        # restored-from-external objects whose backend copy still exists
+        # (cleaned at delete); and oids whose one restart-recovery probe
+        # already missed (never probe the backend again for them)
+        self._ever_spilled: set = set()
+        self._probe_missed: set = set()
         self.spilled_bytes_total = 0
         self.restored_bytes_total = 0
 
@@ -192,7 +208,10 @@ class LocalObjectStore:
                 self._lru[object_id] = time.monotonic()
 
     def register_external(self, object_id: ObjectID):
-        """Account for an object written directly by a worker process."""
+        """Account for an object written directly by a worker process —
+        this is how MOST objects enter the store, so capacity is enforced
+        here too (spilling older objects to make room; the new object is
+        already on shm, so the budget is made around it)."""
         path = _obj_path(self.store_dir, object_id)
         try:
             size = os.path.getsize(path)
@@ -200,6 +219,10 @@ class LocalObjectStore:
             return
         with self._lock:
             if object_id not in self._sizes:
+                try:
+                    self._ensure_space_locked(size)
+                except ObjectStoreFullError:
+                    pass  # already written: track the overshoot honestly
                 self._sizes[object_id] = size
                 self._used += size
                 self._lru[object_id] = time.monotonic()
@@ -207,7 +230,10 @@ class LocalObjectStore:
     # -- read path -----------------------------------------------------------
     def get(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
         buf = read_object(self.store_dir, object_id)
-        if buf is None and object_id in self._spilled:
+        if buf is None and (object_id in self._spilled
+                            or self._external is not None):
+            # second disjunct = restart recovery: a fresh raylet's ledger
+            # doesn't know what its predecessor spilled externally
             if self.restore_if_spilled(object_id):
                 buf = read_object(self.store_dir, object_id)
         if buf is not None:
@@ -217,36 +243,34 @@ class LocalObjectStore:
         return buf
 
     def contains(self, object_id: ObjectID) -> bool:
-        return object_exists(self.store_dir, object_id) \
-            or object_id in self._spilled
+        if object_exists(self.store_dir, object_id) \
+                or object_id in self._spilled:
+            return True
+        if self._external is None or object_id in self._probe_missed:
+            return False
+        try:
+            return self._external.exists(self._spill_key(object_id))
+        except Exception:
+            return False
 
     # -- spilling (ray: local_object_manager.h SpillObjects/restore) ---------
-    def _spill_path(self, object_id: ObjectID) -> str:
-        return os.path.join(self.spill_dir, object_id.hex() + ".obj")
+    @staticmethod
+    def _spill_key(object_id: ObjectID) -> str:
+        # deterministic, node-independent: a restarted raylet (new node
+        # id) can restore a predecessor's externally-spilled objects
+        return object_id.hex() + ".obj"
 
     def _spill_locked(self, object_id: ObjectID) -> bool:
-        """Move one object's file from shm to the spill dir (cross-device
-        copy + unlink); the object stays addressable and is restored on
-        access. Pin counts survive: a spilled primary copy is still the
-        primary copy."""
+        """Move one object's file from shm to the external backend; the
+        object stays addressable and is restored on access. Pin counts
+        survive: a spilled primary copy is still the primary copy."""
         src = _obj_path(self.store_dir, object_id)
-        dst = self._spill_path(object_id)
         size = self._sizes.get(object_id, 0)
         try:
-            with open(src, "rb") as fi, open(dst + ".tmp", "wb") as fo:
-                while True:
-                    chunk = fi.read(8 * 1024 * 1024)
-                    if not chunk:
-                        break
-                    fo.write(chunk)
-            os.replace(dst + ".tmp", dst)
+            self._external.spill(self._spill_key(object_id), src)
             os.unlink(src)
-        except OSError:
-            try:
-                os.unlink(dst + ".tmp")
-            except OSError:
-                pass
-            return False
+        except Exception:
+            return False  # backend errors (boto, plugin) degrade to no-spill
         self._sizes.pop(object_id, None)
         self._lru.pop(object_id, None)
         self._used -= size
@@ -256,30 +280,57 @@ class LocalObjectStore:
 
     def restore_if_spilled(self, object_id: ObjectID) -> bool:
         """Bring a spilled object back into shm (ray:
-        spilled_object_reader.h — we restore whole objects)."""
+        spilled_object_reader.h — we restore whole objects).
+
+        The EXTERNAL copy is deliberately left in place: objects are
+        immutable, so with a shared backend (s3) another raylet may
+        restore the same key concurrently — deleting on restore would
+        destroy a peer's only spilled copy and strand its ledger. The
+        external copy is cleaned when the OBJECT is deleted (refcount
+        zero), tracked via _ever_spilled."""
         with self._lock:
             size = self._spilled.get(object_id)
-            if size is None:
-                return False
-            self._ensure_space_locked(size)
-            src = self._spill_path(object_id)
+            untracked = size is None
+            if untracked:
+                if self._external is None:
+                    return False
+                # restart-recovery probe: at most ONE external lookup per
+                # unseen oid — a routine miss for an object living on
+                # another node must not pay a backend round trip forever
+                if object_id in self._probe_missed:
+                    return False
+            else:
+                try:
+                    self._ensure_space_locked(size)
+                except ObjectStoreFullError:
+                    return False
             dst = _obj_path(self.store_dir, object_id)
             try:
-                with open(src, "rb") as fi, open(dst + ".tmp", "wb") as fo:
-                    while True:
-                        chunk = fi.read(8 * 1024 * 1024)
-                        if not chunk:
-                            break
-                        fo.write(chunk)
-                os.replace(dst + ".tmp", dst)
-                os.unlink(src)
-            except OSError:
-                try:
-                    os.unlink(dst + ".tmp")
-                except OSError:
-                    pass
+                ok = self._external.restore(
+                    self._spill_key(object_id), dst
+                )
+            except Exception:
+                ok = False  # backend errors (boto, plugin) degrade to miss
+            if not ok:
+                if untracked:
+                    if len(self._probe_missed) > 100_000:
+                        self._probe_missed.clear()
+                    self._probe_missed.add(object_id)
                 return False
+            if untracked:
+                # a predecessor raylet spilled this object; its size
+                # wasn't in our (fresh) ledger — the file is already on
+                # shm, so a full store tracks the overshoot honestly
+                try:
+                    size = os.path.getsize(dst)
+                except OSError:
+                    size = 0
+                try:
+                    self._ensure_space_locked(size)
+                except ObjectStoreFullError:
+                    pass
             self._spilled.pop(object_id, None)
+            self._ever_spilled.add(object_id)
             self._sizes[object_id] = size
             self._used += size
             self._lru[object_id] = time.monotonic()
@@ -308,11 +359,14 @@ class LocalObjectStore:
             os.unlink(_obj_path(self.store_dir, object_id))
         except FileNotFoundError:
             pass
-        if self._spilled.pop(object_id, None) is not None:
+        was_spilled = self._spilled.pop(object_id, None) is not None
+        if (was_spilled or object_id in self._ever_spilled) \
+                and self._external is not None:
+            self._ever_spilled.discard(object_id)
             try:
-                os.unlink(self._spill_path(object_id))
-            except FileNotFoundError:
-                pass
+                self._external.delete(self._spill_key(object_id))
+            except Exception:
+                pass  # backend errors must not block the delete
         size = self._sizes.pop(object_id, 0)
         self._used -= size
         self._lru.pop(object_id, None)
@@ -325,20 +379,23 @@ class LocalObjectStore:
     def _ensure_space_locked(self, size: int):
         if self._used + size <= self.capacity:
             return
-        # LRU-evict unpinned objects until there is room.
+        # SPILL-first when a spill target exists: nothing in this runtime
+        # pins primary copies, and deleting the sole copy of a ray.put
+        # object is unrecoverable data loss (puts have no lineage) — a
+        # spilled object stays addressable and restores on access
+        # (ray: local_object_manager.h:40).
+        if self.spill_dir:
+            for oid in list(self._lru.keys()):
+                if self._used + size <= self.capacity:
+                    break
+                self._spill_locked(oid)
+        # No spill target (or spilling failed): LRU-evict unpinned.
         for oid in list(self._lru.keys()):
             if self._used + size <= self.capacity:
                 break
             if oid in self._pinned:
                 continue
             self._delete_locked(oid)
-        # Still short: spill LRU objects (pinned primaries included) to
-        # disk instead of erroring (ray: local_object_manager.h:40).
-        if self._used + size > self.capacity and self.spill_dir:
-            for oid in list(self._lru.keys()):
-                if self._used + size <= self.capacity:
-                    break
-                self._spill_locked(oid)
         if self._used + size > self.capacity:
             raise ObjectStoreFullError(
                 f"object of size {size} does not fit: used={self._used} "

@@ -213,12 +213,29 @@ class Raylet:
         self.host = host
         self.server = RpcServer(self, host, port)
         self.store_dir = os.path.join(session_dir, f"store_{self.node_id[:12]}")
-        # Spill dir lives on real disk, NOT /dev/shm: spilling must actually
-        # relieve memory (ray: object_spilling_config external storage).
-        spill_root = cfg.object_spill_dir or os.path.join(
-            tempfile.gettempdir(), "ray_tpu_spill"
-        )
-        self.spill_dir = os.path.join(spill_root, f"spill_{self.node_id[:12]}")
+        # Spill target lives on real disk, NOT /dev/shm: spilling must
+        # actually relieve memory (ray: object_spilling_config external
+        # storage). A non-file URI (s3://, custom scheme) passes through
+        # UN-scoped: spill keys are object ids, so a restarted raylet can
+        # restore its predecessor's externally-spilled objects.
+        from ray_tpu._private.external_storage import is_local_spill_uri
+
+        if cfg.external_storage_setup_module:
+            # plugin hook: the module registers custom spill schemes via
+            # register_external_storage_scheme before the store is built
+            import importlib
+
+            importlib.import_module(cfg.external_storage_setup_module)
+        if cfg.object_spill_dir and not is_local_spill_uri(
+                cfg.object_spill_dir):
+            self.spill_dir = cfg.object_spill_dir
+        else:
+            spill_root = cfg.object_spill_dir or os.path.join(
+                tempfile.gettempdir(), "ray_tpu_spill"
+            )
+            self.spill_dir = os.path.join(
+                spill_root, f"spill_{self.node_id[:12]}"
+            )
         self.store = object_store.make_local_store(
             self.store_dir, cfg.object_store_memory, self.spill_dir
         )

@@ -344,7 +344,11 @@ class RpcServer:
 
 
 async def connect(host: str, port: int, handler=None, name: str = "client",
-                  retries: int = None, retry_delay: float = None) -> Connection:
+                  retries: int = None, retry_delay: float = None,
+                  token: Optional[str] = None) -> Connection:
+    """``token`` overrides the ambient cluster token for THIS connection —
+    the path to external services with their own credential (the remote
+    KV metadata server, like Redis with requirepass)."""
     from ray_tpu._private.config import GLOBAL_CONFIG
 
     if retries is None:
@@ -355,7 +359,9 @@ async def connect(host: str, port: int, handler=None, name: str = "client",
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, port)
-            writer.write(_auth_preamble(cluster_token()))
+            writer.write(_auth_preamble(
+                cluster_token() if token is None else token
+            ))
             await writer.drain()
             conn = Connection(reader, writer, handler, name=name)
             # Client-side conns get disconnect callbacks too (raylet/worker

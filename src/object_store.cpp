@@ -471,19 +471,23 @@ struct RtpuStore {
   // returns false if space cannot be made (everything pinned, no spill dir)
   bool EnsureSpaceLocked(uint64_t size) {
     if (used + size <= capacity) return true;
+    // SPILL-first when a target exists: nothing pins primary copies in
+    // this runtime, and deleting the sole copy of a ray.put object is
+    // unrecoverable (puts have no lineage); spilled objects stay
+    // addressable and restore on access.
+    if (!spill_dir.empty()) {
+      for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
+        const std::string oid = *it;
+        ++it;
+        SpillOneLocked(oid);
+      }
+    }
     for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
       const std::string oid = *it;
       ++it;  // advance before possible erase
       auto found = objects.find(oid);
       if (found == objects.end() || found->second.pins > 0) continue;
       DeleteLocked(oid);
-    }
-    if (used + size > capacity && !spill_dir.empty()) {
-      for (auto it = lru.begin(); it != lru.end() && used + size > capacity;) {
-        const std::string oid = *it;
-        ++it;
-        SpillOneLocked(oid);
-      }
     }
     return used + size <= capacity;
   }
@@ -584,12 +588,23 @@ long rtpu_store_put(void* store, const char* oid_hex, const uint8_t* metadata,
   return written;
 }
 
-// Account for an object file written directly by a worker process.
+// Account for an object file written directly by a worker process — the
+// main write path, so capacity is enforced here too (spill older objects
+// to make room; the new object already sits on shm, so a full store just
+// tracks the overshoot honestly rather than dropping it).
 void rtpu_store_register_external(void* store, const char* oid_hex) {
   auto* s = static_cast<RtpuStore*>(store);
   struct stat st;
   if (::stat(ObjPath(s->dir, oid_hex).c_str(), &st) != 0) return;
   std::lock_guard<std::mutex> lock(s->mu);
+  // already-tracked check BEFORE making space: a re-register at capacity
+  // must not let EnsureSpace spill the very object being registered
+  // (register_put and register_stored can both report the same oid)
+  if (s->objects.count(oid_hex) || s->spilled.count(oid_hex)) {
+    s->TrackLocked(oid_hex, static_cast<uint64_t>(st.st_size));  // LRU touch
+    return;
+  }
+  s->EnsureSpaceLocked(static_cast<uint64_t>(st.st_size));
   s->TrackLocked(oid_hex, static_cast<uint64_t>(st.st_size));
 }
 

@@ -88,10 +88,13 @@ def test_cli_list_and_summary(ray_start_regular):
     assert "ping" in out.stdout
 
 
-def test_dashboard_ui_served(ray_start_regular):
-    """The single-file UI renders at / and references the JSON API."""
+def test_dashboard_spa_and_full_api_surface(ray_start_regular):
+    """Browser-level smoke without a browser: the SPA page serves with
+    its tab structure, and EVERY endpoint the SPA fetches responds with
+    valid JSON describing live cluster state."""
     from ray_tpu.dashboard import start_dashboard, stop_dashboard
 
+    ray_tpu.get([ping.remote(i) for i in range(3)], timeout=60)
     port = start_dashboard()
     try:
         with urllib.request.urlopen(
@@ -99,6 +102,18 @@ def test_dashboard_ui_served(ray_start_regular):
         ) as resp:
             body = resp.read().decode()
             assert "text/html" in resp.headers["Content-Type"]
-        assert "/api/v0/nodes" in body and "ray_tpu" in body
+        # SPA skeleton: tab nav + the client-side pieces the pages use
+        assert "ray_tpu" in body and 'id="nav"' in body
+        for marker in ("overview", "timeline", "metrics", "filterBar",
+                       "drawTimeline", "spark"):
+            assert marker in body, f"SPA missing {marker}"
+        # every endpoint the SPA's want-map fetches must answer
+        for ep in ("nodes", "actors", "tasks?limit=1000", "objects?limit=500",
+                   "placement_groups", "jobs", "events?limit=200", "metrics",
+                   "timeline", "tasks/summarize", "cluster_resources"):
+            out = _get(port, f"/api/v0/{ep}")
+            assert out is not None, ep
+        nodes = _get(port, "/api/v0/nodes")
+        assert nodes and nodes[0]["alive"]
     finally:
         stop_dashboard()

@@ -1,0 +1,121 @@
+"""External object-spill backends (ray parity:
+python/ray/_private/external_storage.py + local_object_manager.h:40):
+URI-pluggable spill, restart recovery from an external URI, and the
+chaos path through a real cluster with the plugin hook."""
+
+import numpy as np
+import pytest
+
+import tests.external_store_plugin  # registers mocks3:// in this process
+from ray_tpu._private.external_storage import (
+    FileSystemStorage,
+    make_external_storage,
+)
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import LocalObjectStore
+
+
+def test_filesystem_storage_roundtrip(tmp_path):
+    st = make_external_storage(f"file://{tmp_path}/ext")
+    assert isinstance(st, FileSystemStorage)
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload" * 1000)
+    st.spill("k1", str(src))
+    assert st.exists("k1")
+    dst = tmp_path / "back.bin"
+    assert st.restore("k1", str(dst))
+    assert dst.read_bytes() == b"payload" * 1000
+    st.delete("k1")
+    assert not st.exists("k1")
+    assert not st.restore("k1", str(dst))
+
+
+def test_scheme_routing(tmp_path):
+    assert make_external_storage(None) is None
+    assert isinstance(make_external_storage(str(tmp_path)),
+                      FileSystemStorage)
+    assert make_external_storage(f"mocks3://{tmp_path}/m") is not None
+    with pytest.raises(ValueError, match="unknown external storage"):
+        make_external_storage("azureblob://x")
+
+
+def _fill_past_capacity(store, n=6, size=64 * 1024):
+    oids = []
+    for i in range(n):
+        oid = ObjectID((bytes([i]) * 28))
+        payload = bytes([i]) * size
+        store.put(oid, b"meta", [payload], len(payload))
+        store.pin(oid)  # pinned primaries spill rather than evict
+        oids.append((oid, payload))
+    return oids
+
+
+def test_spill_through_custom_scheme(tmp_path):
+    store = LocalObjectStore(
+        str(tmp_path / "shm"), capacity_bytes=200 * 1024,
+        spill_dir=f"mocks3://{tmp_path}/remote",
+    )
+    oids = _fill_past_capacity(store)
+    stats = store.spilled_stats()
+    assert stats["spilled_bytes_total"] > 0
+    # the bytes really moved through the driver's layout
+    assert (tmp_path / "remote" / "manifest.json").exists()
+    # every object still addressable; spilled ones restore on get
+    for oid, payload in oids:
+        buf = store.get(oid)
+        assert buf is not None
+        assert bytes(buf.data) == payload
+        buf.release()
+
+
+def test_externally_spilled_objects_survive_store_restart(tmp_path):
+    """The raylet-restart contract: a FRESH store (new ledger — the old
+    raylet died) restores objects its predecessor spilled to the external
+    URI, because spill keys are object-id-derived."""
+    uri = f"mocks3://{tmp_path}/remote"
+    store = LocalObjectStore(str(tmp_path / "shm1"), 200 * 1024, uri)
+    oids = _fill_past_capacity(store)
+    spilled = [
+        (oid, payload) for oid, payload in oids
+        if not (tmp_path / "shm1" / (oid.hex() + ".obj")).exists()
+    ]
+    assert spilled, "nothing spilled; capacity too large for the test"
+
+    store2 = LocalObjectStore(str(tmp_path / "shm2"), 200 * 1024, uri)
+    for oid, payload in spilled:
+        assert store2.contains(oid)
+        buf = store2.get(oid)
+        assert buf is not None, f"restart recovery failed for {oid}"
+        assert bytes(buf.data) == payload
+        buf.release()
+
+
+def test_cluster_spills_through_plugin_scheme(tmp_path, monkeypatch):
+    """e2e: a real cluster configured with the plugin scheme spills under
+    memory pressure and restores on get (the IO-worker-style path)."""
+    monkeypatch.setenv("RAY_TPU_external_storage_setup_module",
+                       "tests.external_store_plugin")
+    monkeypatch.setenv("RAY_TPU_object_spill_dir",
+                       f"mocks3://{tmp_path}/cluster_remote")
+    # small store so a handful of arrays forces spilling
+    monkeypatch.setenv("RAY_TPU_object_store_memory", str(8 * 1024 * 1024))
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        refs = []
+        arrays = []
+        for i in range(8):
+            a = np.full(2 * 1024 * 1024, i, dtype=np.uint8)
+            arrays.append(a)
+            refs.append(ray_tpu.put(a))
+        # everything must still be retrievable (later puts spilled earlier
+        # ones); correctness beats placement here
+        for i, (r, a) in enumerate(zip(refs, arrays)):
+            got = ray_tpu.get(r, timeout=60)
+            assert got.nbytes == a.nbytes and got[0] == i
+            del got
+        assert (tmp_path / "cluster_remote" / "manifest.json").exists()
+    finally:
+        ray_tpu.shutdown()
