@@ -314,6 +314,25 @@ class DT(Algorithm):
         c = self._ctx
         c["rtg"].append(c["rtg"][-1] - float(reward))
 
+    def evaluate(self, episodes: int = 5) -> Dict:
+        """Return-conditioned greedy rollouts against the configured env
+        (offline DT has no runner gang; the driver rolls out directly).
+        The conditioning target defaults to the dataset's best episode
+        return — "act like your best demonstration"."""
+        from ray_tpu.rllib.env import driver_rollouts
+
+        target = getattr(self.config, "target_return", None)
+        if target is None:
+            target = max(float(ep["rtg"][0]) for ep in self._episodes)
+        score = driver_rollouts(
+            self.config.env, getattr(self.config, "env_config", None),
+            self.compute_single_action, episodes=episodes,
+            on_reset=lambda: self.start_episode(target),
+            on_reward=self.observe_reward,
+        )
+        return {"evaluation": {"episode_return_mean": score,
+                               "num_episodes": episodes}}
+
 
 class DTConfig(AlgorithmConfig):
     def __init__(self):

@@ -132,6 +132,37 @@ class Reacher1D:
 register_env("Reacher1D-native", lambda cfg: Reacher1D(cfg))
 
 
+def driver_rollouts(env_spec, env_config, act_fn, episodes: int = 5,
+                    max_steps: int = 1000, on_reset=None,
+                    on_reward=None) -> float:
+    """Greedy evaluation rollouts run IN the driver (the harness offline
+    algorithms like DT and single-process DreamerV3 share — they have no
+    runner gang to evaluate on). ``act_fn(obs) -> action``; optional
+    ``on_reset()`` / ``on_reward(r)`` hooks maintain per-episode policy
+    context (DT's return conditioning). Returns the mean episode
+    return."""
+    env = make_env(env_spec, env_config)
+    scores = []
+    try:
+        for _ in range(episodes):
+            obs, _info = env.reset()
+            if on_reset is not None:
+                on_reset()
+            total, done, trunc, steps = 0.0, False, False, 0
+            while not (done or trunc) and steps < max_steps:
+                a = act_fn(obs)
+                obs, r, done, trunc, _info = env.step(a)
+                if on_reward is not None:
+                    on_reward(float(r))
+                total += float(r)
+                steps += 1
+            scores.append(total)
+    finally:
+        if hasattr(env, "close"):
+            env.close()
+    return float(np.mean(scores))
+
+
 def env_spaces(env) -> Tuple[tuple, int]:
     """(observation_shape, num_discrete_actions) for built-in or gym envs."""
     if hasattr(env, "observation_shape"):

@@ -58,6 +58,51 @@ def load_experiments(directory: str, select: str = "") -> Dict[str, dict]:
     return out
 
 
+_DATASETS: Dict[str, str] = {}
+
+
+def offline_dataset(kind: str) -> str:
+    """Generate (once per harness run) a shared offline dataset for the
+    offline algorithms' tuned examples (ray parity: the data files
+    shipped under rllib/tuned_examples/ for MARWIL/CQL/DT). The
+    ``cartpole_expert`` dataset is a briefly-trained PPO expert's
+    rollouts with rewards/dones/next_obs."""
+    if kind in _DATASETS:
+        return _DATASETS[kind]
+    if kind != "cartpole_expert":
+        raise ValueError(f"unknown offline dataset {kind!r}")
+    import tempfile
+
+    import ray_tpu as rt
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.offline import write_json
+
+    expert = (
+        PPOConfig()
+        .environment("CartPole-native")
+        .env_runners(num_env_runners=1, rollout_fragment_length=512)
+        .training(num_epochs=6, minibatch_size=128)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        for _ in range(8):
+            expert.train()
+        recorded = rt.get(
+            [expert.runners[0].sample.remote(512) for _ in range(2)],
+            timeout=300,
+        )
+        path = write_json(
+            recorded,
+            os.path.join(tempfile.mkdtemp(prefix="rllib_regression_"),
+                         "expert.jsonl"),
+        )
+    finally:
+        expert.stop()
+    _DATASETS[kind] = path
+    return path
+
+
 def build_algorithm(spec: dict):
     import ray_tpu.rllib as rllib
 
@@ -66,6 +111,10 @@ def build_algorithm(spec: dict):
     if config_cls is None:
         raise ValueError(f"unknown algorithm {algo_name!r}")
     config = config_cls().environment(spec["env"])
+    if spec.get("offline_dataset"):
+        config = config.offline_data(
+            input_=offline_dataset(spec["offline_dataset"])
+        )
     for section, kwargs in (spec.get("config") or {}).items():
         method = getattr(config, section, None)
         if method is None or not callable(method):
@@ -87,12 +136,16 @@ def build_algorithm(spec: dict):
 def run_experiment(name: str, spec: dict) -> dict:
     stop = spec.get("stop") or {}
     threshold = stop.get("episode_return_mean")
-    if threshold is None:
+    # offline algorithms (MARWIL/CQL/DT) never emit training returns —
+    # their pass bar is a post-training greedy EVALUATION return
+    eval_threshold = stop.get("evaluation_return_mean")
+    if threshold is None and eval_threshold is None:
         # a missing/misspelled threshold must not silently auto-pass:
         # this harness exists to catch learning regressions
         raise ValueError(
-            f"experiment {name!r} has no stop.episode_return_mean "
-            f"threshold (found stop keys: {sorted(stop)})"
+            f"experiment {name!r} has no stop.episode_return_mean or "
+            f"stop.evaluation_return_mean threshold "
+            f"(found stop keys: {sorted(stop)})"
         )
     max_iters = int(stop.get("training_iteration", 50))
     algo = build_algorithm(spec)
@@ -105,14 +158,25 @@ def run_experiment(name: str, spec: dict) -> dict:
             r = result.get("episode_return_mean")
             if r is not None:
                 best = max(best, r)
-            if best >= threshold:
+            if threshold is not None and best >= threshold:
                 break
+        eval_score = None
+        if eval_threshold is not None:
+            # judged ALONE: mixing in training returns would let lucky
+            # exploration rollouts mask a regressed greedy policy
+            eval_score = algo.evaluate()["evaluation"][
+                "episode_return_mean"]
     finally:
         algo.stop()
-    passed = best >= threshold
+    if eval_threshold is not None:
+        passed = eval_score >= eval_threshold
+        bar, shown = eval_threshold, eval_score
+    else:
+        passed = best >= threshold
+        bar, shown = threshold, best
     return {
-        "name": name, "passed": passed, "best": best,
-        "threshold": threshold, "iterations": iters,
+        "name": name, "passed": passed, "best": shown,
+        "threshold": bar, "iterations": iters,
         "wall_s": round(time.monotonic() - t0, 1),
     }
 

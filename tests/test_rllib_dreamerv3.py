@@ -1,0 +1,54 @@
+"""DreamerV3 (ray parity: rllib/algorithms/dreamerv3, clean-room JAX):
+world-model components, imagination plumbing, checkpoint state, and a
+learning check on CartPole."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import DreamerV3Config
+from ray_tpu.rllib.dreamerv3 import DreamerV3Module, symexp, symlog
+
+
+def test_symlog_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-4)
+
+
+def test_module_shapes_and_latent_sampling():
+    import jax
+
+    cfg = DreamerV3Config()
+    m = DreamerV3Module(obs_dim=4, num_actions=2, cfg=cfg, seed=0)
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (3, cfg.latent_cats * cfg.latent_classes))
+    z, lg = m.sample_latent(rng, logits)
+    assert z.shape == (3, cfg.latent_cats * cfg.latent_classes)
+    # each categorical block is one-hot in the forward value
+    blocks = np.asarray(z).reshape(3, cfg.latent_cats, cfg.latent_classes)
+    np.testing.assert_allclose(blocks.sum(-1), 1.0, atol=1e-5)
+    assert lg.shape == (3, cfg.latent_cats, cfg.latent_classes)
+
+
+def test_dreamerv3_learns_cartpole():
+    """The world model + imagination-trained actor must clearly beat a
+    random policy within ~7k env steps (the sample-efficiency contract;
+    the tuned example holds the full 100-return bar)."""
+    cfg = DreamerV3Config().environment("CartPole-native").debugging(seed=0)
+    algo = cfg.build()
+    best = 0.0
+    try:
+        for _ in range(35):
+            r = algo.train().get("episode_return_mean")
+            if r is not None:
+                best = max(best, r)
+        assert best > 55.0, best
+        # state roundtrip: params restore exactly
+        state = algo.module.get_state()
+        algo.module.set_state(state)
+        ev = algo.evaluate(episodes=2)["evaluation"]
+        assert ev["num_episodes"] == 2
+    finally:
+        algo.stop()
