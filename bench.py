@@ -43,11 +43,48 @@ _record = {
 }
 _printed = False
 
+_LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_TPU_LAST_GOOD.json")
+
+
+def _load_last_good():
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            out = json.load(f)
+        # a hand-edited non-dict file must not break the must-always-emit
+        # invariant (the merge below calls .get on it)
+        return out if isinstance(out, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _save_last_good():
+    """Persist an on-TPU success into the repo: a later tunnel outage
+    must never erase perf evidence (round-4 verdict item)."""
+    rec = {k: _record[k] for k in ("metric", "value", "unit",
+                                   "vs_baseline", "config")
+           if k in _record}
+    rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    try:
+        with open(_LAST_GOOD_PATH + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(_LAST_GOOD_PATH + ".tmp", _LAST_GOOD_PATH)
+    except OSError:
+        pass
+
 
 def _emit_and_exit(signum=None, frame=None):
     global _printed
     if not _printed:
         _printed = True
+        if _record.get("degraded"):
+            # surface the cached on-chip evidence alongside the smoke
+            last = _load_last_good()
+            if last:
+                _record["last_good_on_tpu"] = {
+                    k: last.get(k) for k in ("value", "vs_baseline",
+                                             "measured_at", "config")
+                }
         print(json.dumps(_record), flush=True)
     os._exit(0)
 
@@ -203,6 +240,7 @@ def main():
             )
             if on_tpu:
                 _record.pop("degraded", None)
+                _save_last_good()
 
     _emit_and_exit()
 
