@@ -281,9 +281,11 @@ class RemoteKvStore:
     lives OFF the head node, so losing the head's disk loses nothing —
     a restarted GCS loads the full snapshot back over the wire.
 
-    Puts are pipelined notifies on one ordered connection (the wire
-    order is the mutation order); ``close`` drains the pipe. Durability
-    window = in-flight notifies, the same posture as Redis pipelining.
+    Puts are ACKNOWLEDGED requests: the mutation is on the server before
+    put() returns, so a kill -9 of the GCS immediately after a client-
+    observed write cannot lose it — the same posture as the sqlite
+    backend's synchronous commit (and ray's Redis store client, which
+    completes GCS mutations in the Redis write callback).
     """
 
     def __init__(self, address: str, cluster_id: Optional[str] = None):
@@ -313,23 +315,26 @@ class RemoteKvStore:
         return out.get("tables", {})
 
     def put(self, table: str, key, value) -> None:
-        async def _send():
-            await self._conn.notify("kv_put", {
-                "cluster_id": self.cluster_id,
-                "entries": [(table, key, value)],
-            })
-
+        if not self._io.loop.is_running():
+            # shutdown race: a stopped-but-open loop would queue the
+            # coroutine forever and block this caller the full timeout
+            return
         try:
-            self._io.call_soon(_send())
+            self._io.run(
+                self._conn.request("kv_put", {
+                    "cluster_id": self.cluster_id,
+                    "entries": [(table, key, value)],
+                }),
+                timeout=30,
+            )
         except RuntimeError:
             pass  # shutdown race: the loop is gone
+        except Exception:
+            # a dropped KV server degrades persistence, not the cluster
+            # (same failure posture as a full disk under the log store)
+            pass
 
     def close(self) -> None:
-        try:
-            # a request after the notify pipeline proves the pipe drained
-            self._io.run(self._conn.request("kv_ping", {}), timeout=10)
-        except Exception:
-            pass
         self._io.stop()
 
 

@@ -51,6 +51,11 @@ class AlgorithmConfig:
         self.env_config: Dict[str, Any] = {}
         self.num_env_runners = 2
         self.rollout_fragment_length = 200
+        # external-env mode (ray parity: PolicyServerInput): when set,
+        # runners host policy servers on consecutive ports instead of
+        # stepping the env; the env is probed for spaces only
+        self.policy_server_port: Optional[int] = None
+        self.policy_server_host: str = "127.0.0.1"
         # >=1: that many learner ACTORS with DDP gradient sync
         # (LearnerGroup); 0 = one in-driver learner (ray parity:
         # config.learners(num_learners=...))
@@ -95,13 +100,20 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners=None,
                     rollout_fragment_length=None,
-                    observation_filter=None, **_kw):
+                    observation_filter=None, policy_server_port=None,
+                    policy_server_host=None, **_kw):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
         if observation_filter is not None:
             self.observation_filter = observation_filter
+        if policy_server_port is not None:
+            # external-env sampling: runner i serves PolicyClients on
+            # port+i instead of stepping an env (rllib/external_env.py)
+            self.policy_server_port = policy_server_port
+        if policy_server_host is not None:
+            self.policy_server_host = policy_server_host
         return self
 
     def evaluation(self, *, evaluation_interval=None,
@@ -237,6 +249,38 @@ class Algorithm(Trainable):
         # Sampling plane runs on host CPUs: the learner owns the TPU chips
         # (libtpu is single-client per host), so runner processes pin JAX
         # to the CPU backend.
+        if getattr(cfg, "policy_server_port", None) is not None:
+            # external-env sampling: each runner hosts a policy server on
+            # port+i; PolicyClients drive the episodes
+            if not getattr(self, "_supports_external_env", False):
+                raise ValueError(
+                    f"policy_server_port is only supported for off-policy "
+                    f"algorithms training from plain transitions (DQN, "
+                    f"SAC) — {type(self).__name__}'s training step needs "
+                    f"on-policy keys (logp/values/bootstrap) external "
+                    f"clients don't produce"
+                )
+            from ray_tpu.rllib.external_env import PolicyServerRunner
+
+            server_cls = ray_tpu.remote(
+                num_cpus=0.5, max_restarts=2, max_task_retries=2,
+                runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
+            )(PolicyServerRunner)
+            self._runner_factory = (
+                lambda i, replacement=False: server_cls.remote(
+                    cfg.env, cfg.env_config,
+                    {"hiddens": hiddens, "dueling": dueling},
+                    seed=cfg.seed + i,
+                    host=cfg.policy_server_host,
+                    port=cfg.policy_server_port + i,
+                )
+            )
+            self.runners = [
+                self._runner_factory(i) for i in range(cfg.num_env_runners)
+            ]
+            self.eval_runners = []
+            self._timesteps = 0
+            return
         runner_cls = ray_tpu.remote(
             num_cpus=0.5,
             # Survive transient worker death (memory-monitor kills under
@@ -633,6 +677,9 @@ class DQN(Algorithm):
     replay (ray parity: rllib/algorithms/dqn)."""
 
     _learner_cls = DQNLearner
+    # trains from plain (obs, a, r, obs', done) transitions: external-env
+    # policy servers can feed it (ray parity: PolicyServerInput examples)
+    _supports_external_env = True
 
     def setup(self, config):
         super().setup(config)
@@ -691,6 +738,7 @@ class SAC(Algorithm):
     rllib/algorithms/sac, discrete variant)."""
 
     _learner_cls = SACLearner
+    _supports_external_env = True  # plain-transition off-policy, like DQN
 
     def setup(self, config):
         super().setup(config)

@@ -26,13 +26,83 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
-@pytest.fixture(scope="module")
-def ray_start_regular():
+# --- shared-cluster fast lane -------------------------------------------
+# Booting GCS + raylet + workers costs ~10-13s; with ~40 modules that is
+# minutes of pure boot. ray_start_regular therefore REUSES the previous
+# module's live cluster when (a) the module doesn't opt out with
+# `RAY_REUSE_CLUSTER = False` at module scope, and (b) the cluster passes
+# a health probe (full CPU capacity free, API responsive) — a module that
+# crashed mid-test and leaked actors recycles instead of poisoning its
+# successors. Fixtures that need a pristine or multi-node cluster tear
+# the shared one down first.
+_shared_cluster = {"active": False}
+
+
+def _teardown_shared():
+    if _shared_cluster["active"]:
+        import ray_tpu
+
+        _shared_cluster["active"] = False
+        ray_tpu.shutdown()
+
+
+def _shared_cluster_healthy() -> bool:
     import ray_tpu
 
+    try:
+        avail = ray_tpu.available_resources()
+        total = ray_tpu.cluster_resources()
+        # all CPUs free again = the previous module cleaned up after itself
+        return avail.get("CPU", 0) >= total.get("CPU", 0) - 0.01
+    except Exception:
+        return False
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular(request):
+    import ray_tpu
+
+    reuse_ok = getattr(request.module, "RAY_REUSE_CLUSTER", True)
+    if _shared_cluster["active"]:
+        if reuse_ok and _shared_cluster_healthy():
+            yield  # adopt the live cluster; leave it for the next module
+            return
+        _teardown_shared()
     ray_tpu.init(num_cpus=4, resources={"custom": 2.0})
+    if reuse_ok:
+        _shared_cluster["active"] = True
+        yield  # stays alive for the next reuse-ok module
+    else:
+        yield
+        ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _shared_cluster_finalizer():
     yield
-    ray_tpu.shutdown()
+    _teardown_shared()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _isolate_self_managed_modules(request):
+    """Modules that call ray_tpu.init()/Cluster() themselves (their own
+    fixtures, custom env vars) must not inherit a live shared cluster —
+    their init would collide with the existing driver connection."""
+    import inspect
+
+    try:
+        src = inspect.getsource(request.module)
+    except (OSError, TypeError):
+        src = ""
+    overrides_fixture = ("def ray_start_regular" in src
+                         or "def ray_start_cluster" in src)
+    uses_conftest_fixture = (not overrides_fixture
+                             and ("ray_start_regular" in src
+                                  or "ray_start_cluster" in src))
+    inits_itself = "ray_tpu.init(" in src or "Cluster(" in src
+    if (overrides_fixture or inits_itself) and not uses_conftest_fixture:
+        _teardown_shared()
+    yield
 
 
 @pytest.fixture
@@ -40,6 +110,7 @@ def ray_start_regular_fn():
     """Function-scoped variant for tests that mutate cluster state."""
     import ray_tpu
 
+    _teardown_shared()
     ray_tpu.init(num_cpus=4)
     yield
     ray_tpu.shutdown()
@@ -47,6 +118,7 @@ def ray_start_regular_fn():
 
 @pytest.fixture
 def ray_start_cluster():
+    _teardown_shared()
     from ray_tpu.cluster_utils import Cluster
 
     cluster = Cluster(initialize_head=False)

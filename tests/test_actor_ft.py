@@ -7,6 +7,10 @@ import pytest
 
 import ray_tpu
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def test_actor_restart(ray_start_regular_fn):
     @ray_tpu.remote(max_restarts=1)

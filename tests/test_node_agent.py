@@ -12,6 +12,10 @@ import requests
 
 import ray_tpu
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def _agent_port():
     from ray_tpu._private.worker import global_worker

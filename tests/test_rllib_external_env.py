@@ -1,0 +1,94 @@
+"""External-env sampling (ray parity: rllib/env/policy_server_input.py +
+policy_client.py): a client-owned env loop drives episodes over HTTP
+against policy-server runners; the algorithm trains from that traffic."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DQNConfig, PolicyClient
+from ray_tpu.rllib.env import make_env
+
+
+def _client_env_loop(address: str, episodes: int, out: dict):
+    """The application side: owns a real CartPole, asks the server for
+    every action, reports rewards — no algorithm imports."""
+    client = PolicyClient(address)
+    env = make_env("CartPole-native")
+    returns = []
+    for _ in range(episodes):
+        eid = client.start_episode()
+        obs, _ = env.reset()
+        total, done, trunc, steps = 0.0, False, False, 0
+        while not (done or trunc) and steps < 200:
+            a = client.get_action(eid, obs)
+            obs, r, done, trunc, _ = env.step(a)
+            client.log_returns(eid, r)
+            total += r
+            steps += 1
+        client.end_episode(eid, obs)
+        returns.append(total)
+    out["returns"] = returns
+
+
+def test_policy_server_end_to_end(ray_start_regular):
+    algo = (
+        DQNConfig()
+        .environment("CartPole-native")  # spaces only; never stepped
+        .env_runners(num_env_runners=1, rollout_fragment_length=64,
+                     policy_server_port=0)
+        .training(minibatch_size=32,
+                  num_steps_sampled_before_learning=64)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        host, port = ray_tpu.get(algo.runners[0].address.remote(),
+                                 timeout=60)
+        out = {}
+        t = threading.Thread(
+            target=_client_env_loop,
+            args=(f"http://{host}:{port}", 30, out), daemon=True,
+        )
+        t.start()
+        # train from external traffic: fragments block until the client
+        # has produced them
+        learned = {}
+        saw_return = False
+        buffer_peak = 0
+        for _ in range(6):
+            learned = algo.train()
+            saw_return = saw_return or "episode_return_mean" in learned
+            buffer_peak = max(buffer_peak, learned.get("buffer_size", 0))
+        t.join(timeout=120)
+        assert not t.is_alive(), "client loop wedged"
+        assert out["returns"], "client never completed an episode"
+        # the algorithm really consumed external transitions
+        assert buffer_peak >= 64, learned
+        assert "loss" in learned or "mean_td_error" in learned, learned
+        # episode metrics flowed from client reports on SOME iteration
+        # (the client may finish before the last train call)
+        assert saw_return
+    finally:
+        algo.stop()
+
+
+def test_policy_client_errors_are_http_errors(ray_start_regular):
+    import urllib.error
+
+    algo = (
+        DQNConfig()
+        .environment("CartPole-native")
+        .env_runners(num_env_runners=1, policy_server_port=0)
+        .build()
+    )
+    try:
+        host, port = ray_tpu.get(algo.runners[0].address.remote(),
+                                 timeout=60)
+        client = PolicyClient(f"http://{host}:{port}")
+        with pytest.raises(urllib.error.HTTPError):
+            client.get_action("no-such-episode", np.zeros(4))
+    finally:
+        algo.stop()

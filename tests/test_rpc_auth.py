@@ -6,6 +6,10 @@ import socket
 
 import ray_tpu
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def test_unauthenticated_peer_rejected(ray_start_regular):
     """A raw TCP client that skips the auth preamble must be disconnected
@@ -19,8 +23,12 @@ def test_unauthenticated_peer_rejected(ray_start_regular):
     )
     host, port = global_worker.core_worker.gcs_addr
 
-    s = socket.create_connection((host, port), timeout=10)
-    s.settimeout(10)
+    # The server holds a garbage (non-preamble) connection until
+    # rpc_auth_timeout_s (10s) elapses before closing; the client must
+    # wait comfortably PAST that or this test is a 10s-vs-10s coin flip
+    # on a loaded box.
+    s = socket.create_connection((host, port), timeout=25)
+    s.settimeout(25)
     try:
         payload = pickle.dumps((1, 0, "kv_keys", {"prefix": ""}), protocol=5)
         s.sendall(len(payload).to_bytes(4, "little") + payload)

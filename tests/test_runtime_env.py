@@ -10,6 +10,10 @@ import pytest
 
 import ray_tpu
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def test_working_dir_ships_files(ray_start_regular, tmp_path):
     wd = tmp_path / "wd"

@@ -12,6 +12,10 @@ import pytest
 
 import ray_tpu
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def test_custom_plugin_materializes_in_worker(monkeypatch):
     monkeypatch.setenv(

@@ -4,6 +4,10 @@ default: local-only, never phones home)."""
 import json
 import os
 
+# cluster-state-mutating module: always gets (and leaves behind) a
+# fresh cluster instead of joining the shared fast-lane one
+RAY_REUSE_CLUSTER = False
+
 
 def test_usage_snapshot_written_on_head_init(ray_start_regular):
     import ray_tpu
