@@ -90,6 +90,9 @@ _flag("object_spill_dir", str, "")  # path or storage URI (file://, s3://, ...)
 # module imported by the raylet before building its store — the hook for
 # register_external_storage_scheme plugins (custom spill backends)
 _flag("external_storage_setup_module", str, "")
+# engine for runtime_env={"container": ...} worker wrapping (a name on
+# PATH or an absolute path; tests point this at a fake engine)
+_flag("container_runtime", str, "podman")
 # Health / fault tolerance
 _flag("heartbeat_interval_s", float, 0.5)
 _flag("node_death_timeout_s", float, 10.0)

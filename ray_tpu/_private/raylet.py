@@ -60,10 +60,15 @@ def runtime_env_hash(runtime_env: Optional[dict]) -> str:
 
 class _Worker:
     def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes],
-                 env_hash: str = "", log_path: Optional[str] = None):
+                 env_hash: str = "", log_path: Optional[str] = None,
+                 cidfile: Optional[str] = None, engine: Optional[str] = None):
         self.proc = proc
         self.job_id = job_id
         self.env_hash = env_hash
+        # container bookkeeping: SIGKILL on the engine client never
+        # reaches the container — kill paths must also `engine rm -f`
+        self.cidfile = cidfile
+        self.engine = engine
         self.conn: Optional[Connection] = None
         self.client_id: Optional[str] = None
         self.busy_with: Optional[bytes] = None  # task_id
@@ -81,6 +86,28 @@ class _Worker:
         self.log_path = log_path
         self.log_offset = 0
         self.log_partial = b""
+
+    def kill_process(self):
+        """Kill the worker AND its container, if any: a plain kill only
+        reaches the container-engine client process (SIGKILL is never
+        proxied inside), which would leak a live container holding its
+        ports and store mappings."""
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        if self.cidfile and self.engine:
+            try:
+                with open(self.cidfile) as f:
+                    cid = f.read().strip()
+                if cid:
+                    subprocess.Popen(
+                        [self.engine, "rm", "-f", cid],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+            except OSError:
+                pass
 
 
 # Pull priorities (ray: pull_manager.h:31-38 BundlePriority — Get before
@@ -586,7 +613,7 @@ class Raylet:
             except Exception:
                 pass
             try:
-                victim.proc.kill()
+                victim.kill_process()
             except Exception:
                 pass
 
@@ -1525,14 +1552,48 @@ class Raylet:
             log_dir,
             f"worker-{self.node_id[:8]}-{self._worker_seq}.out",
         )
+        argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        cidfile = None
+        container = (runtime_env or {}).get("container")
+        if container is not None and (
+            not isinstance(container, dict) or not container.get("image")
+        ):
+            # defense in depth: the driver validates at option time, but a
+            # hand-built spec must not crash the dispatch loop
+            logger.error(
+                "invalid runtime_env['container'] %r: expected a dict with "
+                "'image'; refusing to spawn", container,
+            )
+            return None
+        if container:
+            # container plugin (ray parity: runtime_env/container.py):
+            # the worker process runs INSIDE the image; host network/ipc/
+            # pid namespaces and /dev/shm shared so control plane, data
+            # plane, and pid-keyed registration are unchanged. The
+            # cidfile lets us force-remove the container if we have to
+            # kill the engine client (SIGKILL never proxies inside).
+            from ray_tpu._private.runtime_env import build_container_command
+
+            cidfile = os.path.join(
+                log_dir, f"container-{self.node_id[:8]}-{self._worker_seq}.cid"
+            )
+            env_var_keys = tuple((runtime_env or {}).get("env_vars") or ())
+            argv = build_container_command(
+                container, env,
+                ["python", "-m", "ray_tpu._private.worker_main"],
+                extra_env_keys=env_var_keys + ("PALLAS_AXON_POOL_IPS",),
+                cidfile=cidfile,
+            )
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            argv,
             env=env,
             stdout=open(log_file, "ab"),
             stderr=subprocess.STDOUT,
         )
         w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env),
-                    log_path=log_file)
+                    log_path=log_file, cidfile=cidfile,
+                    engine=(container.get("engine") or cfg.container_runtime)
+                    if container else None)
         self.all_workers[proc.pid] = w
         ehash = w.env_hash
         self._workers_starting[ehash] = \
@@ -1548,7 +1609,7 @@ class Raylet:
                 "alive" if proc.poll() is None
                 else f"exited rc={proc.returncode}",
             )
-            proc.kill()
+            w.kill_process()  # reaches the container too, if any
             self.all_workers.pop(proc.pid, None)
             return None
         finally:

@@ -9,8 +9,9 @@ materializes the URIs it needs into a node-local cache before serving
 tasks (workers are pooled per runtime-env hash, so one worker serves one
 env). pip IS supported offline through a local wheelhouse (see
 _PipPlugin: the wheelhouse ships content-addressed like working_dir and
-workers build a cached venv from it); conda/container are not supported
-in this image and raise up front rather than failing at task time.
+workers build a cached venv from it); conda works against pre-created
+named envs; container wraps the worker command in a podman/docker
+invocation (_ContainerPlugin + raylet spawn wrapping).
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ import io
 import os
 import sys
 import zipfile
-from typing import Optional
+from typing import Dict, List, Optional
 
 _KV_NS = b"runtime_env_packages"
 MAX_PACKAGE_BYTES = 200 * 1024 * 1024
@@ -535,7 +536,84 @@ class _CondaPlugin(RuntimeEnvPlugin):
                 sys.path.insert(0, sp)
 
 
-register_runtime_env_plugin(_UnsupportedPlugin("container"))
+class _ContainerPlugin(RuntimeEnvPlugin):
+    """runtime_env={"container": {"image": ..., "run_options": [...],
+    "engine": "podman"|"docker"|<path>}} — the raylet wraps the worker
+    command in a container invocation (ray parity:
+    _private/runtime_env/container.py, which wraps with podman). The
+    image must carry the same python + ray_tpu importable; network/ipc
+    stay on the host namespaces so the worker reaches the raylet and
+    the /dev/shm object store zero-copy."""
+
+    name = "container"
+    priority = 5  # shape-validate before packaging work
+
+    def validate(self, env: dict) -> None:
+        c = env.get("container")
+        if not c:
+            return
+        if not isinstance(c, dict) or not c.get("image"):
+            raise ValueError(
+                "runtime_env['container'] must be a dict with an 'image' "
+                f"key (got {c!r})"
+            )
+        ro = c.get("run_options", [])
+        if not isinstance(ro, (list, tuple)) or not all(
+            isinstance(o, str) for o in ro
+        ):
+            raise ValueError(
+                "runtime_env['container']['run_options'] must be a list "
+                "of strings"
+            )
+
+    # materialize: nothing to do inside the worker — by the time the
+    # worker runs, it IS in the container (the raylet did the wrapping)
+
+
+def build_container_command(container: dict, env: Dict[str, str],
+                            inner_argv: List[str],
+                            extra_env_keys: tuple = (),
+                            cidfile: Optional[str] = None) -> List[str]:
+    """The worker argv wrapped in a container engine invocation.
+
+    Host network + IPC + **PID** namespaces and /dev/shm + the session
+    dir bind-mounted: the control plane (raylet/GCS ports, pid-keyed
+    worker registration), the data plane (mmap'd object files), and
+    signal delivery must look identical inside the container. The
+    repository root rides along read-only so images without ray_tpu
+    baked in still work for same-version clusters.
+
+    ``extra_env_keys``: additional env names to forward (the caller's
+    runtime_env env_vars + accelerator triggers — the prefix filter
+    below only covers cluster plumbing). ``cidfile``: engine writes the
+    container id there so the raylet can force-remove a container whose
+    client process it had to kill (SIGKILL never proxies).
+    """
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    engine = container.get("engine") or cfg.container_runtime
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+    cmd = [engine, "run", "--rm", "--network=host", "--ipc=host",
+           "--pid=host", "-v", "/dev/shm:/dev/shm"]
+    if cidfile:
+        cmd += ["--cidfile", cidfile]
+    session = env.get("RAY_TPU_SESSION_DIR")
+    if session:
+        cmd += ["-v", f"{session}:{session}"]
+    cmd += ["-v", f"{repo_root}:{repo_root}:ro",
+            "-e", f"PYTHONPATH={repo_root}"]
+    for k, v in env.items():
+        if k.startswith(("RAY_TPU_", "JAX_", "XLA_")) \
+                or k in extra_env_keys:
+            cmd += ["-e", f"{k}={v}"]
+    cmd += list(container.get("run_options", []))
+    cmd.append(container["image"])
+    return cmd + list(inner_argv)
+
+
+register_runtime_env_plugin(_ContainerPlugin())
 register_runtime_env_plugin(_CondaPlugin())
 register_runtime_env_plugin(_PipPlugin())
 register_runtime_env_plugin(_EnvVarsPlugin())
