@@ -94,21 +94,6 @@ class RuntimeEnvPlugin:
         pass
 
 
-class _UnsupportedPlugin(RuntimeEnvPlugin):
-    priority = 0  # reject before any packaging work
-
-    def __init__(self, name: str):
-        self.name = name
-
-    def validate(self, env: dict) -> None:
-        if env.get(self.name):
-            raise ValueError(
-                f"runtime_env[{self.name!r}] is not supported in this "
-                "offline build (no package installation at task time); "
-                "bake dependencies into the image"
-            )
-
-
 _PLUGINS: dict = {}
 
 

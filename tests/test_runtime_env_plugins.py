@@ -61,11 +61,16 @@ def test_plugin_validate_fails_fast():
     assert prepare_runtime_env(None, {"picky": "good"})["picky"] == "good"
 
 
-def test_unsupported_keys_still_raise():
+def test_malformed_container_still_raises_at_option_time():
+    """container graduated from unsupported to a real plugin (worker
+    wrapping); malformed specs must still fail at option time, not at
+    spawn."""
     from ray_tpu._private.runtime_env import prepare_runtime_env
 
-    with pytest.raises(ValueError, match="not supported"):
+    with pytest.raises(ValueError, match="image"):
         prepare_runtime_env(None, {"container": ["anything"]})
+    out = prepare_runtime_env(None, {"container": {"image": "img:v1"}})
+    assert out["container"]["image"] == "img:v1"
 
 
 def test_pip_without_wheelhouse_raises_documented_error(monkeypatch):
