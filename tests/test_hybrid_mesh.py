@@ -83,3 +83,42 @@ def test_hybrid_mesh_collective_crosses_slices():
     )
     out = fn(x)
     assert bool((np.asarray(out) == 2.0).all())
+
+
+class _FakeTpuDevice:
+    """Stand-in with the real multi-slice attribute surface (virtual CPU
+    devices lack slice_index, so the hardware grouping path needs a
+    mock to be exercised at all)."""
+
+    def __init__(self, id_, slice_index, process_index=0):
+        self.id = id_
+        self.slice_index = slice_index
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"FakeTpu(id={self.id}, slice={self.slice_index})"
+
+
+def test_slice_index_grouping_on_fake_hardware():
+    """Devices carrying real slice_index group BY SLICE (not by position):
+    interleaved enumeration must still put each dcn row on one slice."""
+    from ray_tpu.parallel.mesh_utils import _slice_groups
+
+    devs = [_FakeTpuDevice(i, slice_index=i % 2) for i in range(8)]
+    groups, virtual = _slice_groups(devs, n_ici=4)
+    assert not virtual
+    assert [d.slice_index for d in groups[0]] == [0, 0, 0, 0]
+    assert [d.slice_index for d in groups[1]] == [1, 1, 1, 1]
+
+
+def test_hybrid_mesh_surplus_real_slices_raise():
+    """Real hardware with more slices than the dcn extent must raise
+    (silently dropping processes strands them in multi-controller JAX);
+    an explicit devices= subset is the sanctioned way."""
+    devs = [_FakeTpuDevice(i, slice_index=i // 2) for i in range(8)]  # 4 slices
+    with pytest.raises(ValueError, match="spans 4"):
+        parallel.create_hybrid_mesh({"fsdp": 2}, {"data": 2}, devices=devs)
+    # explicit subset: allowed
+    mesh = parallel.create_hybrid_mesh({"fsdp": 2}, {"data": 2},
+                                       devices=devs[:4])
+    assert mesh.shape == {"data": 2, "fsdp": 2}
