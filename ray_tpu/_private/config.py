@@ -157,6 +157,14 @@ _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)
 # RPC substrate (ray: grpc_server.h / client channel args)
 _flag("rpc_max_message_bytes", int, 1 << 31)
+# wire frame format: 2 = out-of-band buffer table (zero-copy payload
+# buffers), 1 = legacy in-band pickle frames. Clients dialing v2 fall
+# back to v1 automatically when the server doesn't ack it.
+_flag("rpc_frame_version", int, 2)
+# payload buffers at least this big ride v2 frames out-of-band; smaller
+# ones stay in the pickle envelope (a table entry + unjoined write costs
+# more than a tiny memcpy)
+_flag("rpc_oob_min_bytes", int, 512)
 _flag("rpc_auth_timeout_s", float, 10.0)
 _flag("rpc_connect_retries", int, 30)
 _flag("rpc_connect_retry_delay_s", float, 0.1)

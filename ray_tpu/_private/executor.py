@@ -501,7 +501,9 @@ class TaskExecutor:
                 for oid_b, owner in sv.nested_refs:
                     return_pins.append(self.cw.pin_object(oid_b, owner))
             if sv.total_data_len <= cfg.max_direct_call_object_size:
-                results.append(("v", sv.metadata, sv.to_bytes()))
+                # wire form: large result buffers ride the v2 frame
+                # out-of-band, never copied into the pickle stream
+                results.append(("v", sv.metadata, sv.to_wire()))
             else:
                 oid = ObjectID.from_index(tid, i + 1)
                 object_store.write_object(
@@ -577,7 +579,7 @@ class TaskExecutor:
             ObjectRef(ObjectID(oid), tuple(spec.owner)) for oid in item_oids
         ]
         sv = serialization.serialize(refs)
-        results = [("v", sv.metadata, sv.to_bytes())]
+        results = [("v", sv.metadata, sv.to_wire())]
         if return_pins:
             with self.cw._lock:
                 self.cw._return_pins[spec.task_id] = return_pins
@@ -603,7 +605,7 @@ class TaskExecutor:
         return {
             "results": None,
             "error": "task raised" if app_error else "task system error",
-            "error_value": (sv.metadata, sv.to_bytes()),
+            "error_value": (sv.metadata, sv.to_wire()),
             "app_error": app_error,
             "retriable": True,
             # Even a failed task may have stashed arg refs (actor state):

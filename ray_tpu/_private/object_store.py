@@ -54,7 +54,14 @@ class ObjectBuffer:
                 self.data.release()
             except BufferError:
                 pass
-            self._mmap.close()
+            try:
+                self._mmap.close()
+            except BufferError:
+                # zero-copy slices of the data are still exported (e.g. a
+                # chunk view queued on an rpc frame): the mapping closes
+                # when the last view dies — refcounting, so promptly
+                self._mmap = None
+                return
             self._file.close()
             self._mmap = None
 

@@ -149,6 +149,55 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
             return batch // 10
         return _timeit("put+get roundtrip (1KB)", run)
 
+    @bench("put_get_1mb_numpy", "put+get 1MB numpy")
+    def _put_get_1mb():
+        # the zero-copy object-plane latency number: serialize (out-of-band
+        # views) -> shm write -> register -> mmap read -> deserialize
+        arr = np.arange(1024 * 1024, dtype=np.uint8)
+        n = max(1, batch // 10)
+
+        def run():
+            got = None
+            for _ in range(n):
+                got = ray_tpu.get(ray_tpu.put(arr))
+            assert got.nbytes == arr.nbytes
+            del got
+            return n
+        return _timeit("put+get 1MB numpy", run)
+
+    @bench("actor_call_1mb_arg", "actor call 1MB arg")
+    def _actor_1mb_arg():
+        # bulk-argument path: the arg exceeds the inline threshold, so each
+        # call ships it through the object plane and the worker maps it
+        arr = np.arange(1024 * 1024, dtype=np.uint8)
+        a = Sink.remote()
+        ray_tpu.get(a.ping.remote())
+        n = max(1, batch // 10)
+
+        def run():
+            ray_tpu.get([a.ping.remote(arr) for _ in range(n)])
+            return n
+        out = _timeit("actor call 1MB arg", run)
+        ray_tpu.kill(a)
+        return out
+
+    @bench("actor_call_64kb_arg", "actor call 64KB arg")
+    def _actor_64kb_arg():
+        # inline-argument path: below the inline threshold the arg rides the
+        # rpc frame itself — out-of-band on v2, so the array is never copied
+        # into the pickle stream on send
+        arr = np.arange(64 * 1024, dtype=np.uint8)
+        a = Sink.remote()
+        ray_tpu.get(a.ping.remote())
+        n = max(1, batch // 4)
+
+        def run():
+            ray_tpu.get([a.ping.remote(arr) for _ in range(n)])
+            return n
+        out = _timeit("actor call 64KB arg", run)
+        ray_tpu.kill(a)
+        return out
+
     @bench("put_gigabytes", "put gigabytes")
     def _put_gb():
         arr = np.zeros(data_mb * 1024 * 1024, dtype=np.uint8)

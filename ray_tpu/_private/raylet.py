@@ -25,6 +25,7 @@ import asyncio
 import heapq
 import logging
 import os
+import pickle
 import subprocess
 import sys
 import tempfile
@@ -43,7 +44,8 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.rpcio import Connection, RpcServer, connect, spawn
+from ray_tpu._private.rpcio import (Connection, Finalized, RpcServer, connect,
+                                    spawn)
 
 logger = logging.getLogger(__name__)
 
@@ -2012,10 +2014,15 @@ class Raylet:
             while True:
                 if failed[0]:
                     break  # a chunk already failed: stop wasting bandwidth
-                data = bytes(buf.data[off:off + chunk])
+                # zero-copy chunk: a PickleBuffer over the mmap'd store
+                # view rides the v2 frame out-of-band (in-band, one copy,
+                # on a v1 peer); the view is written before request()
+                # resolves, so buf.release() below never races the send
+                view = buf.data[off:off + chunk]
                 payload = {
                     "object_id": oid.binary(), "offset": off,
-                    "total": total, "data": data, "push_id": push_id,
+                    "total": total, "data": pickle.PickleBuffer(view),
+                    "push_id": push_id,
                 }
                 if off == 0:
                     payload["metadata"] = buf.metadata
@@ -2023,7 +2030,7 @@ class Raylet:
                 sends.append(
                     spawn(send(payload))
                 )
-                off += len(data)
+                off += view.nbytes
                 if off >= total:
                     break
             results = await asyncio.gather(*sends, return_exceptions=True)
@@ -2175,13 +2182,18 @@ class Raylet:
         try:
             total = len(buf.data)
             off = p["offset"]
-            data = bytes(buf.data[off : off + p["chunk"]])
-            out = {"exists": True, "total": total, "data": data}
+            # zero-copy chunk straight off the mmap; Finalized defers the
+            # buffer release until the response frame reached the transport
+            out = {
+                "exists": True, "total": total,
+                "data": pickle.PickleBuffer(buf.data[off: off + p["chunk"]]),
+            }
             if off == 0:
                 out["metadata"] = buf.metadata
-            return out
-        finally:
-            buf.release()
+        except BaseException:
+            buf.release()  # failed before handing off: don't leak the mmap
+            raise
+        return Finalized(out, buf.release)
 
     def rpc_delete_object(self, conn: Connection, p):
         self.store.delete(ObjectID(p["object_id"]))

@@ -3,12 +3,20 @@
 Plays the role of the reference's gRPC + asio layer (ray: src/ray/rpc/,
 src/ray/common/asio/): every control-plane process (GCS, raylet, core worker)
 runs one asyncio loop; peers hold persistent duplex connections over which
-either side can issue requests or one-way notifications. Messages are
-length-prefixed pickles: ``[4B len][pickle((msg_id, kind, method, payload))]``.
+either side can issue requests or one-way notifications. Two frame formats
+exist, negotiated per connection (see the auth preamble below):
 
-This is the control plane only — bulk object bytes move through the shm store
-(intra-node) and the object-manager chunk protocol (inter-node), mirroring the
-reference's separation of gRPC control from plasma/object-manager data.
+  v1: ``[4B len][pickle((msg_id, kind, method, payload))]``
+  v2: ``[4B total_len][1B nbufs][4B len x nbufs][pickle5 envelope][buf0]...``
+
+v2 is the zero-copy out-of-band format: the envelope is pickled with a
+``buffer_callback`` so large buffers (numpy arrays, shm chunk views,
+``serialization.BufferList`` members) are never memcpy'd into the pickle
+stream — the flush path writes them to the socket as vectored memoryviews,
+and the receiver reconstructs zero-copy memoryviews over a single read
+buffer. This makes the connection a data plane too: object-manager chunks
+and inline task args/results ride frames without per-hop copies, while the
+shm store stays the intra-node zero-copy path.
 """
 
 from __future__ import annotations
@@ -32,12 +40,36 @@ KIND_ERR = 2
 KIND_NOTIFY = 3
 
 _HDR = 4
+# frames above this size are written unjoined (joining would memcpy MBs);
+# smaller parts coalesce into one socket write per tick
+_JOIN_MAX = 128 * 1024
+# v2 buffer table: 1-byte count field caps out-of-band buffers per frame;
+# overflow buffers simply stay in-band (correct, one extra copy)
+_MAX_OOB_BUFS = 255
+
+_HAS_EAGER_FACTORY = hasattr(asyncio, "eager_task_factory")
 
 
 def _max_msg() -> int:
     from ray_tpu._private.config import GLOBAL_CONFIG
 
     return GLOBAL_CONFIG.rpc_max_message_bytes
+
+
+def _oob_min() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.rpc_oob_min_bytes
+
+
+def _frame_version() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.rpc_frame_version
+
+
+def _nbytes(part) -> int:
+    return part.nbytes if isinstance(part, memoryview) else len(part)
 
 # --- connection authentication -----------------------------------------
 # Frames are pickles, and unpickling executes code — so no frame may be
@@ -58,18 +90,30 @@ def _max_msg() -> int:
 # preamble replays) and clients do not authenticate the server. That
 # matches the reference's cluster-token posture; deployments that face
 # untrusted networks must wrap transport in TLS/VPN at a lower layer.
+#
+# Frame-version negotiation rides the preamble's magic: a client that
+# speaks the v2 out-of-band frame format opens with magic "RTPU2" (same
+# preamble length); a v2-aware server answers with a single version byte
+# 0x02 and both sides speak v2 from the first frame. A v1-only server
+# fails the digest compare on the unknown magic and closes — the client
+# detects the EOF where the version byte should be and redials with the
+# v1 preamble, so mixed-version clusters never misparse streams. A v1
+# client sending "RTPU1" gets a silent (byte-free) v1 session from a v2
+# server, exactly as before.
 
 _AUTH_MAGIC = b"RTPU1"
+_AUTH_MAGIC_V2 = b"RTPU2"
 _AUTH_LEN = len(_AUTH_MAGIC) + 64
+_V2_ACK = b"\x02"
 
 
 def cluster_token() -> str:
     return os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
 
 
-def _auth_preamble(token: str) -> bytes:
+def _auth_preamble(token: str, version: int = 1) -> bytes:
     digest = hashlib.sha256(token.encode()).hexdigest().encode()
-    return _AUTH_MAGIC + digest
+    return (_AUTH_MAGIC_V2 if version >= 2 else _AUTH_MAGIC) + digest
 
 
 class RpcError(Exception):
@@ -80,18 +124,65 @@ class ConnectionLost(RpcError):
     pass
 
 
+class Finalized:
+    """Handler-return wrapper: ``payload`` is sent as the response, then
+    ``release()`` runs once the frame has been handed to the transport —
+    for responses carrying zero-copy views over resources that must
+    outlive the write (e.g. mmap'd object-store chunks)."""
+
+    __slots__ = ("payload", "release")
+
+    def __init__(self, payload, release: Callable[[], None]):
+        self.payload = payload
+        self.release = release
+
+
+def _decode_v2(data: bytes):
+    """Decode a v2 frame body (everything after the 4B total-length header)
+    into ``(msg_id, kind, method, payload)``. Out-of-band buffers become
+    zero-copy memoryviews over ``data`` — they stay valid (and readonly)
+    for as long as the payload holds them, independent of the connection."""
+    if len(data) < 1:
+        raise RpcError("corrupt v2 frame: empty body")
+    nbufs = data[0]
+    view = memoryview(data)
+    if nbufs == 0:  # control-plane common case: no table to parse
+        return pickle.loads(view[1:])
+    env_start = 1 + 4 * nbufs
+    if env_start > len(data):
+        raise RpcError("corrupt v2 frame: buffer table truncated")
+    lens = [
+        int.from_bytes(view[1 + 4 * i: 5 + 4 * i], "little")
+        for i in range(nbufs)
+    ]
+    env_end = len(data) - sum(lens)
+    if env_end < env_start:
+        raise RpcError("corrupt v2 frame: buffers exceed frame length")
+    bufs = []
+    pos = env_end
+    for n in lens:
+        bufs.append(view[pos: pos + n])
+        pos += n
+    return pickle.loads(view[env_start:env_end], buffers=bufs)
+
+
 class Connection:
     """One duplex peer connection. Owned by exactly one event loop."""
 
     _ids = itertools.count(1)
 
-    def __init__(self, reader, writer, handler: Optional[object] = None, name: str = "?"):
+    def __init__(self, reader, writer, handler: Optional[object] = None,
+                 name: str = "?", version: int = 1):
         self.reader = reader
         self.writer = writer
         self.handler = handler
         self.name = name
-        # flag read once per connection: the recv loop is the hot path
+        # negotiated frame format (1 = in-band pickle, 2 = out-of-band
+        # buffer table); both peers agreed on it during the auth preamble
+        self.version = version
+        # flags read once per connection: the recv/send loops are hot paths
         self._max_msg = _max_msg()
+        self._oob_min = _oob_min()
         self._pending: Dict[int, asyncio.Future] = {}
         self._msg_ids = itertools.count(1)
         self._send_lock = asyncio.Lock()
@@ -109,19 +200,83 @@ class Connection:
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
         return self._recv_task
 
-    def _enqueue_frame(self, frame: bytes) -> asyncio.Task:
-        """Queue a frame synchronously (caller order = wire order) and
-        return the shared flush task."""
-        self._wbuf.append(frame)
+    def _enqueue_frame(self, parts: tuple) -> asyncio.Task:
+        """Queue one frame's parts synchronously (caller order = wire
+        order) and return the shared flush task."""
+        self._wbuf.append(parts)
         if self._wflush is None or self._wflush.done():
             self._wflush = asyncio.get_running_loop().create_task(
                 self._flush_writes()
             )
         return self._wflush
 
+    def _encode_frame(self, msg_id: int, kind: int, method: str,
+                      payload) -> tuple:
+        """Encode one frame as a tuple of bytes-like parts (written to the
+        socket in order, large parts by reference — no join memcpy).
+
+        v1: one part, ``[4B len][pickle]``.
+        v2: ``[4B total][1B nbufs][4B len x nbufs][envelope]`` as the head
+        part, then each out-of-band buffer as its own part. The envelope is
+        pickled with ``buffer_callback`` so protocol-5-aware payloads
+        (numpy arrays, PickleBuffers, serialization.BufferList members)
+        never enter the pickle stream.
+
+        Raises RpcError BEFORE anything is queued when the frame would
+        exceed ``rpc_max_message_bytes`` — an oversized send must fail
+        loudly at the caller, not opaquely kill the peer's recv loop.
+        """
+        if self.version < 2:
+            data = pickle.dumps((msg_id, kind, method, payload), protocol=5)
+            total = len(data)
+            if total > self._max_msg:
+                raise RpcError(
+                    f"outgoing {method!r} message too large: {total} bytes "
+                    f"> rpc_max_message_bytes={self._max_msg}"
+                )
+            return (total.to_bytes(_HDR, "little") + data,)
+        bufs: list = []
+        oob_min = self._oob_min
+
+        def _cb(pb: pickle.PickleBuffer):
+            try:
+                view = pb.raw()
+            except Exception:
+                return True  # non-contiguous buffer: serialize in-band
+            if view.nbytes < oob_min or len(bufs) >= _MAX_OOB_BUFS \
+                    or view.nbytes > 0xFFFFFFFF:
+                return True  # tiny / table-overflow / >4GiB: in-band
+            bufs.append(view)
+            return False
+
+        env = pickle.dumps((msg_id, kind, method, payload), protocol=5,
+                           buffer_callback=_cb)
+        if not bufs:
+            # control-plane common case: no table, same cost as a v1 frame
+            total = 1 + len(env)
+            if total > self._max_msg:
+                raise RpcError(
+                    f"outgoing {method!r} message too large: {total} bytes "
+                    f"> rpc_max_message_bytes={self._max_msg}"
+                )
+            return (total.to_bytes(_HDR, "little") + b"\x00" + env,)
+        table = b"".join(v.nbytes.to_bytes(4, "little") for v in bufs)
+        total = 1 + len(table) + len(env) + sum(v.nbytes for v in bufs)
+        if total > self._max_msg:
+            raise RpcError(
+                f"outgoing {method!r} message too large: {total} bytes "
+                f"({len(bufs)} out-of-band buffers) "
+                f"> rpc_max_message_bytes={self._max_msg}"
+            )
+        head = b"".join(
+            (total.to_bytes(_HDR, "little"), bytes((len(bufs),)), table, env)
+        )
+        return (head, *bufs)
+
     async def _send(self, msg_id: int, kind: int, method: str, payload):
-        data = pickle.dumps((msg_id, kind, method, payload), protocol=5)
-        flush = self._enqueue_frame(len(data).to_bytes(_HDR, "little") + data)
+        flush = self._enqueue_frame(
+            self._encode_frame(msg_id, kind, method, payload)
+        )
         # await the shared flush so callers keep drain() backpressure;
         # shield: one canceled sender must not kill everyone's flush
         await asyncio.shield(flush)
@@ -135,11 +290,13 @@ class Connection:
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
         msg_id = next(self._msg_ids)
+        # encode before registering the future: an oversized frame raises
+        # here and must not leave a pending entry behind
+        parts = self._encode_frame(msg_id, KIND_REQ, method, payload)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         fut.add_done_callback(lambda _f: self._pending.pop(msg_id, None))
-        data = pickle.dumps((msg_id, KIND_REQ, method, payload), protocol=5)
-        self._enqueue_frame(len(data).to_bytes(_HDR, "little") + data)
+        self._enqueue_frame(parts)
         return fut
 
     async def _flush_writes(self):
@@ -152,8 +309,11 @@ class Connection:
         # Explicit yield so the flush always runs past the currently
         # executing callback: under the loops' EAGER task factory,
         # create_task would otherwise run this body synchronously inside
-        # the first _enqueue_frame and flush one-frame "bursts".
-        await asyncio.sleep(0)
+        # the first _enqueue_frame and flush one-frame "bursts". Without
+        # an eager factory (<=3.11) create_task already defers to the next
+        # loop pass — the yield would only add a scheduling hop per burst.
+        if _HAS_EAGER_FACTORY:
+            await asyncio.sleep(0)
         async with self._send_lock:
             # loop until drained: frames appended while we're suspended in
             # drain() ride THIS task — a sender that sees the task not done
@@ -162,15 +322,20 @@ class Connection:
                 buf, self._wbuf = self._wbuf, []
                 run: list = []
                 for frame in buf:
-                    if len(frame) > 128 * 1024:
-                        # big frame (object chunk): joining would memcpy
-                        # MBs — flush the small run, then write it unjoined
-                        if run:
-                            self.writer.write(b"".join(run))
-                            run = []
-                        self.writer.write(frame)
-                    else:
-                        run.append(frame)
+                    # a frame is a tuple of parts (v2 out-of-band buffers
+                    # ride as separate memoryview parts, by reference)
+                    for part in frame if isinstance(frame, tuple) \
+                            else (frame,):
+                        if _nbytes(part) > _JOIN_MAX:
+                            # big part (object chunk / tensor): joining
+                            # would memcpy MBs — flush the small run in
+                            # order, then hand the view to the transport
+                            if run:
+                                self.writer.write(b"".join(run))
+                                run = []
+                            self.writer.write(part)
+                        else:
+                            run.append(part)
                 if run:
                     self.writer.write(
                         run[0] if len(run) == 1 else b"".join(run)
@@ -204,7 +369,12 @@ class Connection:
                 if n > self._max_msg:
                     raise RpcError(f"oversized message: {n}")
                 data = await self.reader.readexactly(n)
-                msg_id, kind, method, payload = pickle.loads(data)
+                if self.version >= 2:
+                    # ONE read buffer per frame; payload buffers are
+                    # zero-copy memoryviews into it (they keep it alive)
+                    msg_id, kind, method, payload = _decode_v2(data)
+                else:
+                    msg_id, kind, method, payload = pickle.loads(data)
                 if kind == KIND_RESP:
                     fut = self._pending.get(msg_id)
                     if fut and not fut.done():
@@ -241,10 +411,14 @@ class Connection:
             else:
                 logger.warning("%s: dropping notify %r (no handler)", self.name, method)
             return
+        release = None
         try:
             result = fn(self, payload)
             if asyncio.iscoroutine(result):
                 result = await result
+            if isinstance(result, Finalized):
+                release = result.release
+                result = result.payload
             if kind == KIND_REQ:
                 await self._send(msg_id, KIND_RESP, method, result)
         except (ConnectionLost, ConnectionResetError, BrokenPipeError):
@@ -256,6 +430,17 @@ class Connection:
                     await self._send(msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
                 except Exception:
                     pass
+        finally:
+            if release is not None:
+                # the response frame is past _send (handed to the
+                # transport); drop our own reference to the payload so its
+                # buffer views die and release() can close the resource
+                # (e.g. an ObjectBuffer mmap) instead of deferring to GC
+                result = None
+                try:
+                    release()
+                except Exception:
+                    logger.exception("response finalizer failed for %s", method)
 
     async def _do_close(self):
         if self._closed:
@@ -314,11 +499,22 @@ class RpcServer:
         except Exception:
             writer.close()
             return
-        if not hmac.compare_digest(preamble, _auth_preamble(cluster_token())):
+        # run BOTH digest compares unconditionally (constant-time-ish); the
+        # magic picks the negotiated frame version
+        token = cluster_token()
+        is_v2 = hmac.compare_digest(preamble, _auth_preamble(token, 2))
+        is_v1 = hmac.compare_digest(preamble, _auth_preamble(token, 1))
+        if not (is_v1 or is_v2):
             logger.warning("rejecting unauthenticated peer on :%d", self.port)
             writer.close()
             return
-        conn = Connection(reader, writer, self.handler, name=f"server:{self.port}")
+        version = 2 if is_v2 else 1
+        if version >= 2:
+            # version byte after the preamble: confirms v2 to the client
+            # (a v1 server would instead have closed the connection)
+            writer.write(_V2_ACK)
+        conn = Connection(reader, writer, self.handler,
+                          name=f"server:{self.port}", version=version)
         self.connections.add(conn)
 
         def _closed(c):
@@ -345,25 +541,63 @@ class RpcServer:
 
 async def connect(host: str, port: int, handler=None, name: str = "client",
                   retries: int = None, retry_delay: float = None,
-                  token: Optional[str] = None) -> Connection:
+                  token: Optional[str] = None,
+                  version: Optional[int] = None) -> Connection:
     """``token`` overrides the ambient cluster token for THIS connection —
     the path to external services with their own credential (the remote
-    KV metadata server, like Redis with requirepass)."""
+    KV metadata server, like Redis with requirepass).
+
+    ``version`` pins the frame format (default: the rpc_frame_version
+    flag). A v2 dial that the peer rejects — a pre-v2 server closes the
+    connection at the digest compare — falls back to a fresh v1 dial, so
+    mixed-version clusters interoperate for one release."""
     from ray_tpu._private.config import GLOBAL_CONFIG
 
     if retries is None:
         retries = GLOBAL_CONFIG.rpc_connect_retries
     if retry_delay is None:
         retry_delay = GLOBAL_CONFIG.rpc_connect_retry_delay_s
+    want = _frame_version() if version is None else version
     last = None
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, port)
-            writer.write(_auth_preamble(
-                cluster_token() if token is None else token
-            ))
-            await writer.drain()
-            conn = Connection(reader, writer, handler, name=name)
+            tok = cluster_token() if token is None else token
+            negotiated = 1
+            if want >= 2:
+                writer.write(_auth_preamble(tok, 2))
+                await writer.drain()
+                try:
+                    ack = await asyncio.wait_for(
+                        reader.readexactly(1),
+                        GLOBAL_CONFIG.rpc_auth_timeout_s,
+                    )
+                except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                        ConnectionResetError, OSError) as e:
+                    # peer closed instead of acking: a v1-only server (or a
+                    # token mismatch — v1 surfaces those on first use too).
+                    # Redial speaking v1.
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    want = 1
+                    raise ConnectionRefusedError(
+                        f"v2 handshake refused: {e!r}") from None
+                if ack != _V2_ACK:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    raise ConnectionLost(
+                        f"bad version ack from {host}:{port}: {ack!r}"
+                    )
+                negotiated = 2
+            else:
+                writer.write(_auth_preamble(tok, 1))
+                await writer.drain()
+            conn = Connection(reader, writer, handler, name=name,
+                              version=negotiated)
             # Client-side conns get disconnect callbacks too (raylet/worker
             # GCS-reconnect loops key off this).
             cb = getattr(handler, "on_disconnect", None)

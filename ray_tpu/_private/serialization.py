@@ -26,6 +26,58 @@ FMT_ERROR = b"ER"
 FMT_RAW = b"RW"  # raw bytes payload, zero-copy
 
 
+def _nbytes(b) -> int:
+    return b.nbytes if isinstance(b, memoryview) else len(b)
+
+
+class BufferList:
+    """Wire form of a serialized value's data: the ordered buffer list of a
+    ``SerializedValue`` (``[8B pickle_len][pickle][buf0][buf1]...``) kept as
+    separate buffers instead of one joined blob.
+
+    Pickling a BufferList under protocol 5 wraps each large member in a
+    ``PickleBuffer``: over a v2 rpc connection those ride the frame's
+    out-of-band buffer table — the payload bytes are written to the socket
+    by reference and arrive as zero-copy memoryviews over the receiver's
+    read buffer. Over a v1 connection (or any protocol-5 pickle without a
+    buffer_callback) the same members serialize in-band — one copy, same
+    bytes — so mixed-version peers interoperate. Unpickling yields a
+    BufferList of bytes/memoryview members in the original order;
+    ``deserialize`` consumes either form.
+    """
+
+    __slots__ = ("buffers",)
+
+    def __init__(self, buffers):
+        self.buffers = buffers if isinstance(buffers, list) else list(buffers)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(_nbytes(b) for b in self.buffers)
+
+    def concat(self) -> bytes:
+        bufs = self.buffers
+        if len(bufs) == 1 and isinstance(bufs[0], bytes):
+            return bufs[0]
+        return b"".join(bufs)
+
+    def __reduce_ex__(self, protocol):
+        if protocol >= 5:
+            # same tunable the connection's buffer_callback applies: below
+            # it, a table entry + unjoined write costs more than the memcpy
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            oob_min = GLOBAL_CONFIG.rpc_oob_min_bytes
+            return (BufferList, ([
+                pickle.PickleBuffer(b) if _nbytes(b) >= oob_min
+                else (b if isinstance(b, bytes) else bytes(b))
+                for b in self.buffers
+            ],))
+        return (BufferList, ([
+            b if isinstance(b, bytes) else bytes(b) for b in self.buffers
+        ],))
+
+
 class SerializedValue:
     __slots__ = ("metadata", "buffers", "total_data_len", "nested_refs")
 
@@ -36,7 +88,22 @@ class SerializedValue:
         self.nested_refs = nested_refs
 
     def to_bytes(self) -> bytes:
-        return b"".join(bytes(b) for b in self.buffers)
+        """Materialize the data as ONE bytes object (a snapshot: exactly one
+        copy per buffer via join; buffers already bytes are returned or
+        joined without an intermediate ``bytes(b)`` copy)."""
+        bufs = self.buffers
+        if len(bufs) == 1 and isinstance(bufs[0], bytes):
+            return bufs[0]  # raw-bytes value: no copy at all
+        return b"".join(bufs)
+
+    def to_wire(self) -> BufferList:
+        """Zero-copy wire form: the live buffer list (views into the value
+        being serialized — e.g. a numpy array's memory). Large members cross
+        v2 rpc frames out-of-band without ever being copied on the send
+        side. Because the views alias the caller's value, the caller must
+        not mutate the underlying buffers until the send completes (for a
+        task call: until its result future resolves)."""
+        return BufferList(self.buffers)
 
 
 def _pack(fmt: bytes, pickled: bytes, oob: List, nested_refs) -> SerializedValue:
@@ -57,7 +124,9 @@ def serialize(value: Any) -> SerializedValue:
 
     def buffer_callback(pb: pickle.PickleBuffer):
         view = pb.raw()
-        if view.nbytes >= 512:  # keep tiny buffers in-band
+        # store-layout threshold (distinct from the wire's
+        # rpc_oob_min_bytes): tiny buffers stay inside the pickled stream
+        if view.nbytes >= 512:
             oob.append(view)
             return False
         return True
@@ -107,9 +176,35 @@ class TaskError(Exception):
 
 
 def deserialize(metadata: bytes, data) -> Any:
-    """Deserialize from metadata + a bytes-like data view (zero-copy capable)."""
+    """Deserialize from metadata + data, where ``data`` is a bytes-like view
+    (zero-copy capable) or a ``BufferList`` as received off a v2 rpc frame
+    (zero-copy: buffers are consumed in place, never joined)."""
     meta = pickle.loads(metadata)
     fmt = meta["fmt"]
+    if isinstance(data, BufferList):
+        bufs = data.buffers
+        # fast path: the list still has _pack's structure
+        # [8B pickle_len][pickle][oob buffers matching buf_lens] — feed the
+        # out-of-band buffers straight to pickle without reassembly
+        if (
+            fmt != FMT_RAW
+            and len(bufs) == len(meta["buf_lens"]) + 2
+            and _nbytes(bufs[0]) == 8
+            and int.from_bytes(bytes(bufs[0]), "little") == _nbytes(bufs[1])
+            and all(
+                _nbytes(b) == n for b, n in zip(bufs[2:], meta["buf_lens"])
+            )
+        ):
+            value = pickle.loads(
+                bufs[1], buffers=[memoryview(b) for b in bufs[2:]]
+            )
+            if fmt == FMT_ERROR:
+                exc, tb, info = value
+                raise TaskError(exc, tb, info)
+            return value
+        data = data.concat()  # re-chunked upstream: fall through
+    if fmt == FMT_RAW and isinstance(data, bytes):
+        return data
     view = memoryview(data)
     if fmt == FMT_RAW:
         return bytes(view)

@@ -276,7 +276,9 @@ class CoreWorker:
             # registered any kept borrows (ray: reference_count.h arg pins).
             pins.append(self.pin_object(oid, owner))
         if sv.total_data_len <= cfg.max_direct_call_object_size:
-            return ("v", sv.metadata, sv.to_bytes())
+            # wire form, not a joined copy: large buffers (numpy/jax host
+            # arrays) cross the v2 rpc frame out-of-band, by reference
+            return ("v", sv.metadata, sv.to_wire())
         ref = self._put_serialized(sv)
         # Keep the implicit put alive until the consuming task finishes.
         pins.append(self.pin_object(ref.binary(), ref.owner))
@@ -914,7 +916,7 @@ class CoreWorker:
             self._specs_inflight.pop(spec.task_id, None)
         for i in range(max(1, spec.num_returns)):
             oid = ObjectID.from_index(tid, i + 1)
-            self._resolve_inline(oid.binary(), sv.metadata, sv.to_bytes())
+            self._resolve_inline(oid.binary(), sv.metadata, sv.to_wire())
         self._fail_dynamic_item_futures(spec, sv)
         self._release_task_pins(spec.task_id)
 
@@ -931,7 +933,7 @@ class CoreWorker:
                 if oid.startswith(prefix) and not f.done()
             ]
         for oid in pending:
-            self._resolve_inline(oid, sv.metadata, sv.to_bytes())
+            self._resolve_inline(oid, sv.metadata, sv.to_wire())
 
     # ------------------------------------------------------------------
     # submission
@@ -1390,7 +1392,7 @@ class CoreWorker:
             else:
                 exc = RuntimeError(p["error"])
             sv = serialization.serialize_error(exc, spec.name if spec else "")
-            meta, data = sv.metadata, sv.to_bytes()
+            meta, data = sv.metadata, sv.to_wire()
         for i in range(n_returns):
             oid = ObjectID.from_index(tid, i + 1)
             self._resolve_inline(oid.binary(), meta, data)
@@ -1414,7 +1416,9 @@ class CoreWorker:
                     await self._register_borrow_for(oid_b, owner, tuple(exec_addr))
             self._release_task_pins(task_id)
 
-    def _resolve_inline(self, oid: bytes, metadata: bytes, data: bytes):
+    def _resolve_inline(self, oid: bytes, metadata: bytes, data):
+        """``data`` is bytes or a serialization.BufferList (the zero-copy
+        wire form — deserialize consumes either)."""
         with self._lock:
             self._memory_store[oid] = (metadata, data)
             fut = self._futures.get(oid)
@@ -1694,6 +1698,8 @@ class CoreWorker:
         # already registered with their owners; the pin extends the lifecycle.
         tokens = [self.pin_object(o, w) for o, w in sv.nested_refs]
         if sv.total_data_len <= cfg.max_direct_call_object_size:
+            # to_bytes, not to_wire: put() snapshots — the stored value must
+            # not alias the caller's (possibly mutated-later) buffers
             with self._lock:
                 self._memory_store[oid.binary()] = (sv.metadata, sv.to_bytes())
                 self._owned.add(oid.binary())

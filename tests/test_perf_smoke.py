@@ -1,0 +1,67 @@
+"""Control-plane perf smoke: the ``ray_tpu microbenchmark --small`` suite
+wired into tier-1, so a regression in the hot rpc/serialization paths shows
+up in CI instead of only in manual bench runs.
+
+Floors are SOFT and ratio-based only — absolute ops/s on a shared CI box
+swing ~2x run to run, but the *shape* of the suite is stable: pipelined
+submission must beat serial round-trips, and moving a 1MB payload must not
+collapse the call rate by the full copy cost. Each floor sits far (5-10x)
+below healthy values so only a structural regression (a lost fast path, an
+accidental per-op copy of bulk bytes) trips it.
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench_results(ray_start_regular):
+    from ray_tpu._private.perf import run_microbenchmarks
+
+    results = run_microbenchmarks(
+        select="", small=True
+    )
+    return {r["benchmark"]: r["value"] for r in results}
+
+
+def test_suite_runs_and_reports(bench_results):
+    expected = {
+        "single client tasks sync",
+        "single client tasks async",
+        "1:1 actor calls sync",
+        "1:1 actor calls async",
+        "n:n actor calls async",
+        "put+get 1MB numpy",
+        "actor call 1MB arg",
+        "actor call 64KB arg",
+        "put gigabytes",
+    }
+    missing = expected - set(bench_results)
+    assert not missing, f"benchmarks missing from the suite: {missing}"
+    assert all(v > 0 for v in bench_results.values()), bench_results
+
+
+def test_async_submission_beats_serial_roundtrips(bench_results):
+    # pipelining exists at all: an async burst must outrun one-at-a-time
+    # sync round-trips (healthy ratio is ~10x; floor at 1.5x)
+    assert bench_results["single client tasks async"] >= \
+        1.5 * bench_results["single client tasks sync"], bench_results
+    assert bench_results["1:1 actor calls async"] >= \
+        1.5 * bench_results["1:1 actor calls sync"], bench_results
+
+
+def test_bulk_args_do_not_collapse_call_rate(bench_results):
+    # a 64KB inline arg rides the frame out-of-band: the call rate must
+    # stay within 50x of the empty-arg async rate (a lost zero-copy path
+    # shows up as a far bigger collapse under --small batch sizes)
+    assert bench_results["actor call 64KB arg"] >= \
+        bench_results["1:1 actor calls async"] / 50.0, bench_results
+
+
+def test_object_plane_moves_bulk_bytes(bench_results):
+    # put+get of 1MB implies >= value * 2MB/s of object-plane bandwidth;
+    # require a floor far below the shm store's capability but far above
+    # any accidental per-op pickle/copy regression
+    bandwidth = bench_results["put+get 1MB numpy"] * 2 * (1 << 20)
+    assert bandwidth >= 50 * (1 << 20), (
+        f"object plane at {bandwidth / 1e6:.1f} MB/s", bench_results,
+    )
