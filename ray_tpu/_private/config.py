@@ -157,17 +157,41 @@ _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)
 # RPC substrate (ray: grpc_server.h / client channel args)
 _flag("rpc_max_message_bytes", int, 1 << 31)
-# wire frame format: 2 = out-of-band buffer table (zero-copy payload
-# buffers), 1 = legacy in-band pickle frames. Clients dialing v2 fall
-# back to v1 automatically when the server doesn't ack it.
-_flag("rpc_frame_version", int, 2)
+# wire frame format: 3 = out-of-band buffer table + CRC32 head trailer,
+# 2 = out-of-band buffer table (zero-copy payload buffers), 1 = legacy
+# in-band pickle frames. Clients dialing high fall back one version per
+# redial when the server doesn't ack it. The v3 CRC covers the frame head
+# (count byte + buffer table + envelope): corrupted control data is
+# detected and the connection reset instead of unpickling garbage;
+# out-of-band payload buffers stay CRC-free (checksumming multi-MB tensors
+# would re-scan the memory the zero-copy path exists to avoid).
+_flag("rpc_frame_version", int, 3)
 # payload buffers at least this big ride v2 frames out-of-band; smaller
 # ones stay in the pickle envelope (a table entry + unjoined write costs
 # more than a tiny memcpy)
 _flag("rpc_oob_min_bytes", int, 512)
 _flag("rpc_auth_timeout_s", float, 10.0)
 _flag("rpc_connect_retries", int, 30)
+# connect() retry backoff: delay starts at rpc_connect_retry_delay_s,
+# doubles per attempt, caps at rpc_connect_backoff_max_s (with jitter).
+# Budget check: 30 retries = ~3s of doubling + 27 capped waits ≈ 57s
+# worst-case, inside gcs_client_reconnect_timeout_s (60s).
 _flag("rpc_connect_retry_delay_s", float, 0.1)
+_flag("rpc_connect_backoff_max_s", float, 2.0)
+# default deadline for Connection.request() when the caller passes no
+# timeout — no control-plane RPC may hang forever on a silent peer.
+# Long-poll methods (borrower polls, waits) pass explicit timeouts.
+_flag("rpc_request_timeout_s", float, 120.0)
+# call_with_retries backoff envelope (idempotent control-plane calls and
+# token-carrying side-effectful ones)
+_flag("rpc_retry_attempts", int, 5)
+_flag("rpc_retry_base_delay_s", float, 0.1)
+_flag("rpc_retry_max_delay_s", float, 2.0)
+# keepalive: ping idle connections every interval; a peer silent for the
+# timeout is declared dead (black-holed peers surface in O(timeout)
+# instead of hanging a request forever). 0 disables. v3+ sessions only.
+_flag("rpc_keepalive_interval_s", float, 2.0)
+_flag("rpc_keepalive_timeout_s", float, 20.0)
 # Serve (ray: serve/_private defaults)
 _flag("serve_control_loop_period_s", float, 0.25)
 _flag("serve_default_graceful_shutdown_timeout_s", float, 5.0)

@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set
 
+from ray_tpu._private import faultsim
 from ray_tpu._private.common import NodeInfo, TaskSpec, place_bundles, res_fits
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.rpcio import Connection, RpcServer, spawn
@@ -198,6 +199,7 @@ class GcsServer:
 
     async def start(self):
         port = await self.server.start()
+        faultsim.set_self_id(f"gcs:{port}")
         self._tasks.append(spawn(self._health_loop()))
         if self._recovered:
             self._tasks.append(
@@ -709,8 +711,14 @@ class GcsServer:
                 await asyncio.sleep(cfg.gcs_schedule_retry_interval_s)
                 continue
             try:
+                # No rpc idem token: the scheduling loop legitimately
+                # re-asks the same node after a transient rejection, and a
+                # token would replay the cached rejection forever. Lost-
+                # reply dedup lives in the raylet instead — rpc_create_actor
+                # re-answers for an actor_id it already runs.
                 reply = await self.node_conns[target].request(
-                    "create_actor", {"spec": spec}, timeout=cfg.gcs_rpc_timeout_s
+                    "create_actor", {"spec": spec},
+                    timeout=cfg.gcs_rpc_timeout_s,
                 )
             except Exception as e:
                 logger.warning("actor creation on %s failed: %s", target[:8], e)
@@ -868,6 +876,10 @@ class GcsServer:
                         ok = False
                         break
                     try:
+                        # no rpc idem token: prepare/cancel cycles across
+                        # placement attempts would replay stale results.
+                        # Dedup is app-level — rpc_pg_prepare acks a bundle
+                        # it already holds without double-reserving.
                         r = await nconn.request(
                             "pg_prepare",
                             {"pg_id": pg.pg_id, "bundle_index": idx,

@@ -206,6 +206,31 @@ class NodeProcesses:
             self.raylet_proc.kill()
         self.raylet_proc.wait(timeout=10)
 
+    # -- network chaos hooks (see _private/faultsim.py) -----------------
+    # Every control-plane process spawned from here inherits
+    # RAY_TPU_RPC_FAULTS / RAY_TPU_RPC_FAULTS_FILE through its env; the
+    # FILE variant is re-read live, so faults can be armed and HEALED
+    # while raylet/GCS subprocesses keep running. Export the env var
+    # BEFORE building the cluster — children snapshot their env at spawn.
+
+    def set_network_faults(self, spec: str):
+        """(Re)write the live fault spec file. Requires
+        RAY_TPU_RPC_FAULTS_FILE to have been exported before this node's
+        processes started."""
+        path = os.environ.get("RAY_TPU_RPC_FAULTS_FILE")
+        assert path, (
+            "export RAY_TPU_RPC_FAULTS_FILE before starting the cluster "
+            "to use dynamic fault injection"
+        )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(spec)
+        os.replace(tmp, path)  # atomic: readers never see a half-written spec
+
+    def clear_network_faults(self):
+        """Heal: remove every armed network fault."""
+        self.set_network_faults("")
+
     def kill_gcs(self):
         """Chaos hook: kill the GCS process (head only). State survives in
         the persist log; ``restart_gcs`` brings it back on the same port."""

@@ -44,7 +44,9 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.rpcio import (Connection, Finalized, RpcServer, connect,
+from ray_tpu._private import faultsim
+from ray_tpu._private.rpcio import (Connection, Finalized, RpcError,
+                                    RpcServer, call_with_retries, connect,
                                     spawn)
 
 logger = logging.getLogger(__name__)
@@ -237,6 +239,8 @@ class Raylet:
         node_id: Optional[str] = None,
     ):
         self.node_id = node_id or NodeID.from_random().hex()
+        # chaos identity: partition rules target "<node_id>><peer_addr>"
+        faultsim.set_self_id(self.node_id)
         self.gcs_host, self.gcs_port = gcs_host, gcs_port
         self.session_dir = session_dir
         self.host = host
@@ -312,6 +316,10 @@ class Raylet:
         # chunk pipelines + receiver-side assembly buffers
         self._pushes_inflight: Dict[tuple, asyncio.Future] = {}
         self._push_peer_sems: Dict[str, asyncio.Semaphore] = {}
+        # in-flight actor creations: a retried create_actor (caller
+        # deadline raced a slow worker spawn) joins the pending future
+        # instead of spawning a second worker for the same actor_id
+        self._actors_creating: Dict[bytes, asyncio.Future] = {}
         # in-flight worker spawns per env hash + wakeup for waiters
         # (requests wait on a booting same-env worker instead of racing
         # another spawn against it)
@@ -427,10 +435,11 @@ class Raylet:
                 await asyncio.sleep(0.1)
             with open(port_file) as f:
                 self.agent_port = int(f.read().strip())
-            await self.gcs.request("kv_put", {
-                "ns": b"node_agents", "key": self.node_id.encode(),
-                "value": str(self.agent_port).encode(),
-            })
+            await call_with_retries(
+                lambda: self.gcs, "kv_put", {
+                    "ns": b"node_agents", "key": self.node_id.encode(),
+                    "value": str(self.agent_port).encode(),
+                })
         except Exception:
             logger.warning("node agent failed to start", exc_info=True)
 
@@ -649,8 +658,13 @@ class Raylet:
         delay = 0.2
         while not self._stopping:
             try:
+                # few retries per cycle: the OUTER loop owns long-horizon
+                # pacing, and a short inner dial keeps post-recovery
+                # reconnect latency low (connect()'s full 30-attempt
+                # backoff could leave us sleeping seconds after the GCS
+                # is already back)
                 conn = await connect(self.gcs_host, self.gcs_port, handler=self,
-                                     name="gcs-conn")
+                                     name="gcs-conn", retries=3)
                 reply = await conn.request(
                     "register_node", self._register_payload(),
                     timeout=cfg.gcs_rpc_timeout_s,
@@ -738,8 +752,16 @@ class Raylet:
                         timeout=cfg.gcs_rpc_timeout_s,
                     )
                     self._on_view(reply["nodes"])
+            except (RpcError, OSError):
+                # transient (RpcError covers ConnectionLost/RpcTimeoutError):
+                # the reconnect loop (on_disconnect) owns recovery; the next
+                # tick re-reports our state. Counted so chaos tests can see
+                # the unhealthy window.
+                self.counters["gcs_rpc_failures"] = (
+                    self.counters.get("gcs_rpc_failures", 0) + 1
+                )
             except Exception:
-                pass
+                logger.exception("heartbeat failed (non-transport)")
             # reclaim byte charges of push sessions whose sender died
             # (waiting for the next inbound push to sweep could wedge the
             # shared transfer budget indefinitely)
@@ -809,8 +831,16 @@ class Raylet:
             conn = await connect(info.host, info.port, handler=self,
                                  name=f"peer:{node_id[:8]}", retries=5)
         except Exception:
+            # visible chaos window: partition tests assert on this count
+            self.counters["peer_dial_failures"] = (
+                self.counters.get("peer_dial_failures", 0) + 1
+            )
             return None
         await conn.request("register_peer", {"node_id": self.node_id})
+        # stamp the dial side too: faultsim partition rules and disconnect
+        # bookkeeping can then identify the peer by node id, matching what
+        # register_peer records on the accepting side
+        conn.meta.update(kind="peer", node_id=node_id)
         self.peers[node_id] = conn
         return conn
 
@@ -855,6 +885,9 @@ class Raylet:
         elif kind == "peer":
             peer_id = conn.meta.get("node_id")
             self.peers.pop(peer_id, None)
+            self.counters["peer_conns_lost"] = (
+                self.counters.get("peer_conns_lost", 0) + 1
+            )
             # drop the per-peer push pipeline with the peer (elastic
             # clusters churn nodes; semaphores must not accumulate)
             self._push_peer_sems.pop(peer_id, None)
@@ -1140,7 +1173,17 @@ class Raylet:
                 prev_origin = getattr(spec, "origin_node", None)
                 spec.origin_node = self.node_id
                 try:
-                    await peer.request("spill_submit", {"spec": spec, "depth": depth + 1})
+                    # NO idem token here, deliberately: the handler itself
+                    # chains spill_submit RPCs, and a task ping-ponging
+                    # A->B->A->B reuses the same (task, attempt, sender)
+                    # identity — dedup would make the second arrival await
+                    # the first's still-running handler, a distributed
+                    # deadlock. Wire-duplicate frames are already dropped
+                    # by per-connection msg-id dedup, and this path never
+                    # retries blindly (_spilled_away owns resubmission).
+                    await peer.request(
+                        "spill_submit", {"spec": spec, "depth": depth + 1}
+                    )
                     self.counters["tasks_spilled"] += 1
                     # We now carry the resubmission liability for this task
                     # (normal tasks only: actor restarts are GCS-driven);
@@ -1315,7 +1358,12 @@ class Raylet:
     async def _run_on_worker(self, qt: _QueuedTask, w: _Worker):
         self._emit_task_event(qt.spec, "RUNNING", pid=w.proc.pid)
         try:
-            result = await w.conn.request("execute_task", {"spec": qt.spec})
+            # timeout=0 (unbounded): this await spans the USER CODE's whole
+            # runtime — a deadline here would falsely kill long tasks and
+            # double-execute them on retry. Keepalive covers the dead-peer
+            # case the default deadline exists for.
+            result = await w.conn.request("execute_task", {"spec": qt.spec},
+                                          timeout=0)
         except Exception as e:
             result = None
             logger.warning("dispatch to worker failed: %s", e)
@@ -1625,6 +1673,36 @@ class Raylet:
     # ------------------------------------------------------------------
     async def rpc_create_actor(self, conn: Connection, p):
         spec: TaskSpec = p["spec"]
+        # App-level idempotency: a retried creation (the reply to the first
+        # attempt was lost in flight, or the caller's deadline expired while
+        # the worker was still spawning) must join the live/in-flight
+        # creation, not spawn a second worker for the same actor_id. This
+        # is the dedup layer for create_actor — an rpc-level idem token is
+        # wrong here because the scheduler legitimately re-asks after
+        # transient rejections, and a cached {"rejected"} would poison
+        # every later attempt on this node.
+        w = self.local_actors.get(spec.actor_id)
+        if w is not None and w.conn is not None and not w.conn.closed:
+            return {"worker_client_id": w.client_id,
+                    "direct_addr": (self.host, w.direct_port)
+                    if w.direct_port else None}
+        pending = self._actors_creating.get(spec.actor_id)
+        if pending is not None:
+            # a retry racing the in-flight creation shares its outcome
+            # (resolved with a reply dict, never an exception)
+            return await asyncio.shield(pending)
+        fut = asyncio.get_running_loop().create_future()
+        self._actors_creating[spec.actor_id] = fut
+        reply = {"rejected": True}
+        try:
+            reply = await self._do_create_actor(spec)
+            return reply
+        finally:
+            self._actors_creating.pop(spec.actor_id, None)
+            if not fut.done():
+                fut.set_result(reply)
+
+    async def _do_create_actor(self, spec: TaskSpec) -> dict:
         if not res_fits(spec.resources, self.resources_available):
             return {"rejected": True}
         w = await self._pop_worker(spec)
@@ -1716,7 +1794,9 @@ class Raylet:
 
     async def _run_actor_task(self, spec: TaskSpec, w: _Worker):
         try:
-            result = await w.conn.request("execute_task", {"spec": spec})
+            # timeout=0: spans the actor method's runtime (see dispatch path)
+            result = await w.conn.request("execute_task", {"spec": spec},
+                                          timeout=0)
         except Exception:
             # actor worker died mid-task; GCS failure path notifies owner of
             # actor death; report retriable failure for this call.
@@ -1840,9 +1920,12 @@ class Raylet:
                              "node_id": self.node_id},
                         )
                     try:
-                        await self.gcs.request(
-                            "add_object_location",
-                            {"object_id": oid.binary(), "node_id": self.node_id},
+                        # retried (idempotent): a dropped registration would
+                        # leave the new copy invisible to the directory
+                        await call_with_retries(
+                            lambda: self.gcs, "add_object_location",
+                            {"object_id": oid.binary(),
+                             "node_id": self.node_id},
                         )
                     except Exception:
                         pass
@@ -2330,6 +2413,13 @@ class Raylet:
     async def rpc_pg_prepare(self, conn: Connection, p):
         from ray_tpu._private.common import rewrite_resources_for_pg
 
+        # App-level idempotency: a duplicated/retried prepare for a bundle
+        # we already hold must ack without reserving twice. (An rpc-level
+        # idem token is wrong here: pg_cancel legitimately rolls the
+        # reservation back between placement attempts, and a cached "ok"
+        # would ack a later attempt without actually re-reserving.)
+        if (p["pg_id"], p["bundle_index"]) in self.pg_bundles:
+            return {"ok": True}
         resources = p["resources"]
         if not res_fits(resources, self.resources_available):
             return {"ok": False}

@@ -26,13 +26,13 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import object_store, serialization
+from ray_tpu._private import faultsim, object_store, serialization
 from ray_tpu._private.common import SchedulingStrategy, TaskSpec, rewrite_resources_for_pg
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpcio import (Connection, EventLoopThread, RpcServer,
-                                    connect)
+                                    call_with_retries, connect)
 
 logger = logging.getLogger(__name__)
 
@@ -102,6 +102,9 @@ class CoreWorker:
         namespace: Optional[str] = None,
     ):
         self.client_id = WorkerID.from_random().hex()
+        # chaos identity (faultsim partition rules match on it): drivers
+        # and workers are labeled so raylet-to-raylet partitions miss them
+        faultsim.set_self_id(f"worker:{self.client_id[:12]}")
         self.is_driver = is_driver
         self.namespace = namespace or "default"
         self.executor = None  # set by TaskExecutor on worker processes
@@ -111,13 +114,14 @@ class CoreWorker:
         )
         # workers spawned during a GCS outage must come up once it returns:
         # give non-drivers the same patience as the raylet reconnect loop
-        # instead of the default ~3s of connect retries
-        gcs_retries = None if is_driver else max(1, int(
-            cfg.gcs_client_reconnect_timeout_s / cfg.rpc_connect_retry_delay_s
-        ))
+        # (a wall-clock budget — connect() retries with exponential backoff
+        # until the deadline). Drivers get a SHORT budget instead: an
+        # interactive init() against a dead/mistyped address should fail in
+        # seconds, not ride 30 capped-backoff attempts for a minute.
         self.gcs: Connection = self.io.run(
             connect(gcs_host, gcs_port, handler=self, name="gcs-conn",
-                    retries=gcs_retries)
+                    total_timeout=10.0 if is_driver
+                    else cfg.gcs_client_reconnect_timeout_s)
         )
         self.gcs_addr = (gcs_host, gcs_port)
         if is_driver and job_id is None:
@@ -455,7 +459,14 @@ class CoreWorker:
         if not batch:
             return
         try:
-            await self.raylet.request("submit_batch", {"specs": batch})
+            # retried with backoff; the idem token (first task id is unique
+            # to this batch) keeps a retry whose original actually landed
+            # from enqueueing every spec twice
+            await call_with_retries(
+                lambda: self.raylet, "submit_batch", {"specs": batch},
+                idem=("submit_batch", batch[0].task_id, batch[0].attempt,
+                      len(batch)),
+            )
             for spec in batch:
                 self._submit_stage[spec.task_id] = "raylet_accepted"
         except Exception as e:
@@ -614,16 +625,19 @@ class CoreWorker:
             for spec in batch:
                 self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
             try:
+                # timeout=0 (unbounded): these awaits span the USER CODE's
+                # runtime — a deadline would falsely fail long tasks.
+                # Keepalive detects the dead-worker case instead.
                 if len(batch) == 1:
                     results = [await conn.request(
-                        "execute_task", {"spec": batch[0]}
+                        "execute_task", {"spec": batch[0]}, timeout=0
                     )]
                 else:
                     # batch results STREAM back as task_result notifies as
                     # each task finishes (so ray.wait sees early tasks);
                     # the response is only the completion ack
                     await conn.request(
-                        "execute_task_batch", {"specs": batch}
+                        "execute_task_batch", {"specs": batch}, timeout=0
                     )
                     results = None
             except Exception:
@@ -1058,9 +1072,17 @@ class CoreWorker:
             spec.kwargs = {k: self._finalize_slot(s, pins)
                            for k, s in enc_kwargs.items()}
             self._hold_actor_creation_pins(actor_id.binary(), pins)
+            # side-effectful: the actor_id itself is the idempotency token,
+            # so a retried registration can't double-register the actor
             reply = self.io.run(
-                self.gcs.request("register_actor", {"spec": spec}),
-                timeout=cfg.gcs_rpc_timeout_s,
+                call_with_retries(
+                    lambda: self.gcs, "register_actor", {"spec": spec},
+                    timeout=cfg.gcs_rpc_timeout_s,
+                    idem=("register_actor", actor_id.binary()),
+                ),
+                # outer bound > worst-case inner (attempts x (rpc + backoff))
+                timeout=(cfg.gcs_rpc_timeout_s + cfg.rpc_retry_max_delay_s)
+                * cfg.rpc_retry_attempts + 5.0,
             )
             if reply.get("error"):
                 raise ValueError(reply["error"])
@@ -1085,7 +1107,10 @@ class CoreWorker:
         spec.args = [self._finalize_slot(s, pins) for s in enc_args]
         spec.kwargs = {k: self._finalize_slot(s, pins) for k, s in enc_kwargs.items()}
         self._hold_actor_creation_pins(spec.actor_id, pins)
-        await self.gcs.request("register_actor", {"spec": spec})
+        await call_with_retries(
+            lambda: self.gcs, "register_actor", {"spec": spec},
+            idem=("register_actor", spec.actor_id),
+        )
 
     def _hold_actor_creation_pins(self, actor_id: bytes, pins: List):
         """Actor-creation args must survive restarts: the GCS replays the
@@ -1657,8 +1682,10 @@ class CoreWorker:
                              cfg.gcs_client_reconnect_timeout_s)
                 return
             try:
+                # short inner dial; the outer loop paces the long outage
                 conn = await connect(self.gcs_addr[0], self.gcs_addr[1],
-                                     handler=self, name="gcs-conn")
+                                     handler=self, name="gcs-conn",
+                                     retries=3)
                 await conn.request(
                     "register_client",
                     {"client_id": self.client_id, "job_id": self.job_id,
@@ -2373,7 +2400,10 @@ class CoreWorker:
                     await self._ensure_object_available(a[1], a[2] if len(a) > 2 else None)
                 except Exception as e:
                     logger.warning("arg recovery for reconstruction failed: %s", e)
-        await self.raylet.request("submit_task", {"spec": spec})
+        await call_with_retries(
+            lambda: self.raylet, "submit_task", {"spec": spec},
+            idem=("submit", spec.task_id, spec.attempt),
+        )
         return fut
 
     async def _ensure_object_available(self, oid: bytes, owner=None):

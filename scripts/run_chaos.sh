@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos lane: the heavy kill/partition/fault-matrix tests (pytest -m chaos).
+#
+# The fast deterministic fault-injection tests are UNMARKED and run in the
+# tier-1 lane; everything marked `chaos` boots real multi-process clusters
+# under armed fault plans (see ray_tpu/_private/faultsim.py) and is kept
+# out of tier-1 by an additional `slow` mark where heavy.
+#
+# Usage:
+#   scripts/run_chaos.sh              # whole chaos lane
+#   scripts/run_chaos.sh -k partition # subset
+#
+# Replaying a chaos failure: every armed fault plan is logged at WARNING
+# ("faultsim armed ...") with its full spec, including each rule's seed.
+# Re-export the logged spec verbatim (RAY_TPU_RPC_FAULTS=...) to replay
+# the same decision sequence.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${CHAOS_TIMEOUT:-1800}"
+exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m chaos -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@"

@@ -23,7 +23,42 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import time
+
 import pytest
+
+
+def pytest_configure(config):
+    # registered here as well as pytest.ini so `pytest tests/test_x.py`
+    # from any cwd stays warning-free
+    config.addinivalue_line(
+        "markers", "slow: heavy/long test, excluded from the tier-1 lane")
+    config.addinivalue_line(
+        "markers",
+        "chaos: kill/partition/fault-injection chaos test "
+        "(run the heavy ones via scripts/run_chaos.sh)")
+
+
+def wait_for_condition(condition, timeout: float = 30.0,
+                       retry_interval_ms: float = 100.0, **kwargs):
+    """Poll ``condition(**kwargs)`` until truthy (analog of ray:
+    _private/test_utils.py wait_for_condition). Raises RuntimeError with
+    the last exception on timeout. Use this instead of fixed sleeps:
+    restarts are awaited, not guessed."""
+    deadline = time.monotonic() + timeout
+    last_exc = None
+    while time.monotonic() < deadline:
+        try:
+            if condition(**kwargs):
+                return
+            last_exc = None
+        except Exception as e:  # flaky probes retry until the deadline
+            last_exc = e
+        time.sleep(retry_interval_ms / 1000.0)
+    suffix = f" (last exception: {last_exc!r})" if last_exc else ""
+    raise RuntimeError(
+        f"condition {getattr(condition, '__name__', condition)!r} not met "
+        f"within {timeout}s{suffix}")
 
 
 # --- shared-cluster fast lane -------------------------------------------

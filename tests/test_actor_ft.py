@@ -1,11 +1,11 @@
 """Actor fault-tolerance tests (restart, kill) — fresh cluster per test."""
 
 import os
-import time
 
 import pytest
 
 import ray_tpu
+from tests.conftest import wait_for_condition
 
 # cluster-state-mutating module: always gets (and leaves behind) a
 # fresh cluster instead of joining the shared fast-lane one
@@ -27,9 +27,13 @@ def test_actor_restart(ray_start_regular_fn):
     d = Dying.remote()
     pid1 = ray_tpu.get(d.get_pid.remote(), timeout=30)
     d.die.remote()
-    time.sleep(2)
-    pid2 = ray_tpu.get(d.get_pid.remote(), timeout=60)
-    assert pid2 != pid1  # restarted in a fresh process
+
+    def restarted_in_new_process():
+        # calls during the restart window raise; keep probing until the
+        # replacement process answers (awaited, not guessed via sleep)
+        return ray_tpu.get(d.get_pid.remote(), timeout=15) != pid1
+
+    wait_for_condition(restarted_in_new_process, timeout=60)
 
 
 def test_kill_actor(ray_start_regular_fn):
@@ -41,6 +45,14 @@ def test_kill_actor(ray_start_regular_fn):
     v = Victim.remote()
     assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"
     ray_tpu.kill(v)
-    time.sleep(1)
+
+    def ping_fails():
+        try:
+            ray_tpu.get(v.ping.remote(), timeout=10)
+            return False
+        except Exception:
+            return True
+
+    wait_for_condition(ping_fails, timeout=30)
     with pytest.raises(Exception):
-        ray_tpu.get(v.ping.remote(), timeout=15)
+        ray_tpu.get(v.ping.remote(), timeout=10)

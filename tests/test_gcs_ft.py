@@ -167,15 +167,19 @@ def test_object_transfer_survives_gcs_outage(ray_start_cluster):
     cluster.head.restart_gcs()
     assert _gcs_alive(cluster.head.gcs_port)
 
-    # Driver's GCS conn reconnects asynchronously after the restart.
+    # Driver's GCS conn AND the raylet's re-registration are both
+    # asynchronous after the restart: wait for a live node to show up, not
+    # merely for the first successful (possibly still-empty) response.
     deadline = time.monotonic() + 30
     stats = None
     while time.monotonic() < deadline:
         try:
             stats = ray_tpu.nodes()
-            break
+            if stats and any(n["alive"] for n in stats):
+                break
         except Exception:
-            time.sleep(0.3)
+            pass
+        time.sleep(0.3)
     assert stats and any(n["alive"] for n in stats)
     ref2 = ray_tpu.put(np.arange(200_000, dtype=np.int64))
     assert ray_tpu.get(consume.remote(ref2), timeout=90) == int(

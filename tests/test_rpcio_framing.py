@@ -55,7 +55,9 @@ def _roundtrip(payload, version=2):
     total = int.from_bytes(wire[:4], "little")
     body = wire[4: 4 + total]
     assert len(body) == total, "frame length header must cover the body"
-    if version >= 2:
+    if version >= 3:
+        return rpcio._decode_v3(body)
+    if version == 2:
         return _decode_v2(body)
     return pickle.loads(body)
 
@@ -102,6 +104,35 @@ def test_roundtrip_fuzz_mixed():
         assert len(got["bufs"]) == n
         for g, w in zip(got["bufs"], value["bufs"]):
             assert np.array_equal(g, w)
+
+
+@pytest.mark.parametrize("nbufs", [0, 1, 3, 32])
+def test_v3_crc_roundtrip(nbufs):
+    arrs = [np.arange(1000 * (i + 1), dtype=np.int32) for i in range(nbufs)]
+    msg_id, kind, method, payload = _roundtrip(
+        {"arrs": arrs, "tag": "t"}, version=3)
+    assert (msg_id, kind, method) == (7, KIND_REQ, "m")
+    assert payload["tag"] == "t"
+    for got, want in zip(payload["arrs"], arrs):
+        assert np.array_equal(got, want)
+
+
+def test_v3_crc_detects_head_corruption():
+    """Any flipped byte in the CRC-covered head (count byte, table,
+    envelope) must raise the typed corruption error."""
+    parts = _conn(3)._encode_frame(1, KIND_NOTIFY, "m",
+                                   {"arr": np.zeros(4096, dtype=np.uint8)})
+    wire = b"".join(bytes(p) for p in parts)
+    body = bytearray(wire[4:])
+    head_len = len(bytes(parts[0])) - 4  # head part minus the 4B length
+    for off in (0, 5, head_len - 5, head_len - 1):
+        mutated = bytearray(body)
+        mutated[off] ^= 0x01
+        with pytest.raises(rpcio.FrameCorruptError):
+            rpcio._decode_v3(bytes(mutated))
+    # untouched body still decodes
+    _, _, _, payload = rpcio._decode_v3(bytes(body))
+    assert payload["arr"].nbytes == 4096
 
 
 def test_frame_exactly_at_max_message_passes():
@@ -207,16 +238,16 @@ class EchoHandler:
         return Finalized({"ok": True}, _rel)
 
 
-def test_v2_negotiation_and_echo():
+def test_v3_negotiation_and_echo():
     async def main():
         handler = EchoHandler()
         srv = RpcServer(handler)
         port = await srv.start()
         conn = await connect("127.0.0.1", port, name="c", retries=3)
         try:
-            assert conn.version == 2
+            assert conn.version == 3  # default: v2 framing + CRC trailer
             (sconn,) = srv.connections
-            assert sconn.version == 2
+            assert sconn.version == 3
             arr = np.arange(65536, dtype=np.uint8)
             reply = await conn.request("echo", {"arr": arr})
             assert np.array_equal(reply["arr"], arr)
@@ -255,6 +286,25 @@ def test_v1_client_against_v2_server():
         finally:
             await conn.close()
             await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_frame_version_flag_pins_v2():
+    async def main():
+        GLOBAL_CONFIG.update({"rpc_frame_version": 2})
+        try:
+            srv = RpcServer(EchoHandler())
+            port = await srv.start()
+            conn = await connect("127.0.0.1", port, name="c", retries=3)
+            assert conn.version == 2
+            arr = np.arange(65536, dtype=np.uint8)
+            reply = await conn.request("echo", {"arr": arr})
+            assert np.array_equal(reply["arr"], arr)
+            await conn.close()
+            await srv.stop()
+        finally:
+            GLOBAL_CONFIG.reset()
 
     asyncio.run(main())
 
