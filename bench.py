@@ -157,9 +157,36 @@ def _watchdog_thread():
     _emit_and_exit()
 
 
+def _profiler_overhead_main():
+    """BENCH_PROFILER_OVERHEAD=1: measure task-throughput degradation
+    under 100 Hz cluster-wide CPU sampling (the profiling subsystem's
+    acceptance number: <5% at 100 Hz) and emit ONE JSON line, same
+    contract as the default bench path."""
+    import ray_tpu
+    from ray_tpu.util.profiling import profiler_overhead_bench
+
+    hz = float(os.environ.get("BENCH_PROFILER_HZ", "100"))
+    ray_tpu.init(num_cpus=2)
+    try:
+        out = profiler_overhead_bench(hz=hz)
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": f"profiler_overhead_fraction_{int(hz)}hz",
+        "value": out["overhead_fraction"],
+        "unit": "fraction",
+        "vs_baseline": 1.0 if out["sampling_cpu_fraction"] < 0.05 else 0.0,
+        "detail": out,
+    }), flush=True)
+    os._exit(0)
+
+
 def main():
     signal.signal(signal.SIGTERM, _emit_and_exit)
     threading.Thread(target=_watchdog_thread, daemon=True).start()
+
+    if os.environ.get("BENCH_PROFILER_OVERHEAD"):
+        _profiler_overhead_main()
 
     on_tpu = _tpu_reachable()
 

@@ -113,6 +113,22 @@ _flag("gcs_store_fsync", bool, False)
 _flag("memory_usage_threshold", float, 0.95)
 _flag("memory_monitor_refresh_ms", int, 250)
 _flag("memory_monitor_test_path", str, "")  # test injection: file with a float
+# On-demand profiling (profiler.py: sampled CPU flamegraphs + mem diffs)
+_flag("profiler_default_hz", float, 100.0)
+_flag("profiler_max_hz", float, 1000.0)
+# sampling self-throttles when (time spent sampling / wall time) would
+# exceed this fraction — attaching to a loaded worker stays <5% overhead
+_flag("profiler_max_overhead_fraction", float, 0.05)
+_flag("profiler_max_duration_s", float, 600.0)
+_flag("profiler_mem_top_n", int, 30)
+_flag("profiler_mem_frames", int, 8)
+# GCS remote-KV persistence put pipeline (gcs_store.RemoteKvStore): puts
+# are queued onto the kv io thread (ordered, batched) so a slow KV server
+# never blocks the GCS event loop; a failed flush trips a circuit breaker
+# into the degraded no-persist posture for the cooldown.
+_flag("gcs_kv_put_timeout_s", float, 5.0)
+_flag("gcs_kv_queue_max", int, 10_000)
+_flag("gcs_kv_breaker_cooldown_s", float, 30.0)
 # Metrics / events
 _flag("metrics_report_interval_s", float, 2.0)
 _flag("task_events_buffer_size", int, 10_000)

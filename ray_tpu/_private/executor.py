@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from ray_tpu._private import object_store, serialization
+from ray_tpu._private import object_store, profiler, serialization
 from ray_tpu._private.common import TaskSpec
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -249,7 +249,8 @@ class TaskExecutor:
                     args, kwargs = r[1]
                     self.current_task_id = spec.task_id
                     try:
-                        out = (idx, True, call(*args, **kwargs))
+                        with profiler.tag_current_thread.for_spec(spec):
+                            out = (idx, True, call(*args, **kwargs))
                     except Exception as e:
                         out = (idx, False, e)
                     finally:
@@ -368,8 +369,8 @@ class TaskExecutor:
                 else:
                     value = await loop.run_in_executor(
                         self.pool,
-                        lambda: self._invoke_traced(
-                            lambda: method(*args, **kwargs), ctx
+                        lambda: self._invoke_user(
+                            spec, lambda: method(*args, **kwargs), ctx
                         ),
                     )
             else:
@@ -383,8 +384,8 @@ class TaskExecutor:
                 else:
                     value = await loop.run_in_executor(
                         self.pool,
-                        lambda: self._invoke_traced(
-                            lambda: func(*args, **kwargs), ctx
+                        lambda: self._invoke_user(
+                            spec, lambda: func(*args, **kwargs), ctx
                         ),
                     )
         except Exception as e:
@@ -412,6 +413,13 @@ class TaskExecutor:
                 cache.pop(next(iter(cache)))
             cache[key] = fn
         return fn
+
+    def _invoke_user(self, spec, fn, ctx):
+        """Run user code on a pool thread with the sampling profiler's
+        thread tag set (per-task/actor attribution in CPU profiles) on
+        top of the traced invocation."""
+        with profiler.tag_current_thread.for_spec(spec):
+            return self._invoke_traced(fn, ctx)
 
     @staticmethod
     def _invoke_traced(fn, ctx):

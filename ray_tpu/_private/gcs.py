@@ -492,14 +492,34 @@ class GcsServer:
     # ------------------------------------------------------------------
     # Internal KV (ray: gcs_kv_manager.h)
     # ------------------------------------------------------------------
+    async def _persist_kv_awaited(self, key, value):
+        """Persist one user-visible KV mutation BEFORE the client sees
+        the ack. Internal table writes (_persist_actor/_persist_pg) stay
+        fire-and-forget — a slow store must not stall the control plane —
+        but a kv_put the client observed succeeding has to survive a
+        kill -9 of the GCS (the redis-store durability contract). Stores
+        with an awaitable path (RemoteKvStore.aput) flush without
+        blocking the event loop; local stores write synchronously (disk,
+        microseconds). Returns False when the flush did NOT land (breaker
+        open / put timeout) so the ack can say so."""
+        aput = getattr(self._store, "aput", None)
+        if aput is None:
+            self._store.put("kv", key, value)
+            return True
+        return bool(await aput("kv", key, value))
+
     async def rpc_kv_put(self, conn: Connection, p):
         nsname = p.get("ns", "")
         ns = self.kv.setdefault(nsname, {})
         existed = p["key"] in ns
+        persisted = True
         if p.get("overwrite", True) or not existed:
             ns[p["key"]] = p["value"]
-            self._store.put("kv", (nsname, p["key"]), p["value"])
-        return {"added": not existed}
+            persisted = await self._persist_kv_awaited(
+                (nsname, p["key"]), p["value"])
+        # persisted=False = the degraded no-persist posture: the write is
+        # live in memory but would not survive a GCS kill -9 right now
+        return {"added": not existed, "persisted": persisted}
 
     async def rpc_kv_get(self, conn: Connection, p):
         return self.kv.get(p.get("ns", ""), {}).get(p["key"])
@@ -509,12 +529,18 @@ class GcsServer:
         ns = self.kv.get(nsname, {})
         if p.get("prefix"):
             keys = [k for k in ns if k.startswith(p["key"])]
+            deleted = 0
             for k in keys:
-                del ns[k]
-                self._store.put("kv", (nsname, k), None)
-            return len(keys)
+                # pop, not del: the await below suspends the handler, so
+                # a concurrent kv_del may have removed (and tombstoned)
+                # this key already
+                if ns.pop(k, None) is None:
+                    continue
+                deleted += 1
+                await self._persist_kv_awaited((nsname, k), None)
+            return deleted
         if ns.pop(p["key"], None) is not None:
-            self._store.put("kv", (nsname, p["key"]), None)
+            await self._persist_kv_awaited((nsname, p["key"]), None)
             return 1
         return 0
 
@@ -958,6 +984,74 @@ class GcsServer:
             pg = self.pgs.get(p["pg_id"])
             return pg.to_table() if pg else None
         return [pg.to_table() for pg in self.pgs.values()]
+
+    # ------------------------------------------------------------------
+    # On-demand profiling (profiler.py): cluster-wide fan-out + merge
+    # ------------------------------------------------------------------
+    def _profiler(self):
+        svc = getattr(self, "_profiler_svc", None)
+        if svc is None:
+            from ray_tpu._private import profiler
+
+            svc = self._profiler_svc = profiler.ProfilerService(role="gcs")
+        return svc
+
+    async def rpc_profile_start(self, conn: Connection, p):
+        return self._profiler().start(p or {})
+
+    async def rpc_profile_stop(self, conn: Connection, p):
+        return self._profiler().stop(p or {})
+
+    async def rpc_profile_status(self, conn: Connection, p):
+        return self._profiler().status()
+
+    async def rpc_profile_cluster(self, conn: Connection, p):
+        """Fan one profiling window out to every (or one) node's raylet —
+        which fans out to its workers — and merge the results: summed
+        collapsed stacks (cpu) or summed per-site deltas (mem), plus the
+        per-process results for slicing (ray parity: the dashboard's
+        per-pid py-spy attach, lifted to one cluster-wide operation)."""
+        from ray_tpu._private import profiler
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        p = dict(p or {})
+        kind = p.get("kind", "cpu")
+        duration = min(float(p.get("duration") or 5.0),
+                       cfg.profiler_max_duration_s)
+        p["duration"] = duration
+        node_filter = p.get("node_id")
+        targets = []
+        for nid, nconn in list(self.node_conns.items()):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            if node_filter and not nid.startswith(node_filter):
+                continue
+            targets.append((nid, nconn))
+
+        async def one(nid: str, nconn: Connection):
+            try:
+                reply = await nconn.request(
+                    "profile_node", p, timeout=duration + 45.0
+                )
+                return reply.get("processes") or []
+            except Exception as e:
+                return [{"node_id": nid,
+                         "error": f"{type(e).__name__}: {e}"}]
+
+        jobs = [one(nid, nconn) for nid, nconn in targets]
+        if p.get("include_gcs") and not node_filter:
+            async def self_prof():
+                out = await self._profiler().run(p)
+                return [out]
+
+            jobs.append(self_prof())
+        per_node = await asyncio.gather(*jobs)
+        processes = [proc for node_list in per_node for proc in node_list]
+        merged = profiler.merge_profiles(processes, kind=kind)
+        merged["duration_s"] = duration
+        merged["nodes"] = len(targets)
+        return merged
 
     # ------------------------------------------------------------------
     # Task events (observability; ray: gcs_task_manager.h)

@@ -281,11 +281,21 @@ class RemoteKvStore:
     lives OFF the head node, so losing the head's disk loses nothing —
     a restarted GCS loads the full snapshot back over the wire.
 
-    Puts are ACKNOWLEDGED requests: the mutation is on the server before
-    put() returns, so a kill -9 of the GCS immediately after a client-
-    observed write cannot lose it — the same posture as the sqlite
-    backend's synchronous commit (and ray's Redis store client, which
-    completes GCS mutations in the Redis write callback).
+    ``put()`` never blocks the caller: it is called from GCS RPC
+    handlers ON the GCS event loop (gcs.py _persist_actor/_persist_pg),
+    where one synchronous KV round trip per mutation would stall the
+    entire control plane — and a HUNG server would stall it longer than
+    node_death_timeout_s, declaring healthy nodes dead. Mutations are
+    queued FIFO and drained by one writer task on the kv io thread,
+    pipelined in batches; the wire order equals the put order, so a
+    tombstone after a write lands as a tombstone. ``aput()`` is the
+    awaitable variant for client-observed writes (the GCS kv_put handler
+    awaits the flush before acking, restoring the redis-store durability
+    contract without blocking its loop). A failed flush trips a
+    circuit breaker into the degraded no-persist posture (same posture
+    as a full disk under the log store) for
+    ``gcs_kv_breaker_cooldown_s``, then retries. ``close()`` drains the
+    queue (bounded) so a clean shutdown loses nothing.
     """
 
     def __init__(self, address: str, cluster_id: Optional[str] = None):
@@ -306,6 +316,18 @@ class RemoteKvStore:
                                           token=token))
         # fail fast on a wrong address instead of at first load
         self._io.run(self._conn.request("kv_ping", {}), timeout=10)
+        from collections import deque
+
+        self._q: deque = deque()  # of ((table, key, value), ack_fut|None)
+        self._lock = threading.Lock()
+        self._flushing = False
+        self._degraded_until = 0.0
+        self._dropped = 0
+
+    def _cfg(self):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG
 
     def load(self) -> Dict[str, dict]:
         out = self._io.run(
@@ -315,26 +337,127 @@ class RemoteKvStore:
         return out.get("tables", {})
 
     def put(self, table: str, key, value) -> None:
-        if not self._io.loop.is_running():
-            # shutdown race: a stopped-but-open loop would queue the
-            # coroutine forever and block this caller the full timeout
-            return
+        self._enqueue((table, key, value), None)
+
+    async def aput(self, table: str, key, value) -> bool:
+        """Awaitable put for callers on SOME event loop (the GCS kv_put
+        handler): resolves once the mutation is flushed to the server, so
+        a client-observed ack is durable — without ever blocking the
+        caller's loop. Bounded: a degraded server resolves False after
+        the put timeout (well under node_death_timeout_s) instead of
+        stalling the control plane."""
+        import asyncio
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._enqueue((table, key, value), fut)
         try:
-            self._io.run(
-                self._conn.request("kv_put", {
-                    "cluster_id": self.cluster_id,
-                    "entries": [(table, key, value)],
-                }),
-                timeout=30,
-            )
-        except RuntimeError:
-            pass  # shutdown race: the loop is gone
+            return bool(await asyncio.wait_for(
+                asyncio.wrap_future(fut),
+                self._cfg().gcs_kv_put_timeout_s + 1.0,
+            ))
         except Exception:
-            # a dropped KV server degrades persistence, not the cluster
-            # (same failure posture as a full disk under the log store)
-            pass
+            return False
+
+    @staticmethod
+    def _ack(fut, ok: bool):
+        if fut is not None and not fut.done():
+            fut.set_result(ok)
+
+    def _enqueue(self, entry, fut) -> None:
+        if not self._io.loop.is_running():
+            # shutdown race: the drain task can never run
+            self._ack(fut, False)
+            return
+        cfg = self._cfg()
+        with self._lock:
+            if len(self._q) >= cfg.gcs_kv_queue_max:
+                # overload: drop the OLDEST entry — for a same-key churn
+                # the newest write is the one that must win, and the
+                # breaker below is what normally bounds the queue anyway
+                _, old_fut = self._q.popleft()
+                self._ack(old_fut, False)
+                self._dropped += 1
+            self._q.append((entry, fut))
+            if self._flushing:
+                return
+            self._flushing = True
+        try:
+            self._io.loop.call_soon_threadsafe(self._start_drain)
+        except RuntimeError:
+            with self._lock:
+                self._flushing = False
+            self._ack(fut, False)
+
+    def _start_drain(self):
+        # on the kv io loop; keep a strong ref so the task can't be GC'd
+        task = self._io.loop.create_task(self._drain())
+        self._drain_task = task
+
+    async def _drain(self):
+        import asyncio
+        import logging
+        import time as _time
+
+        cfg = self._cfg()
+        log = logging.getLogger(__name__)
+        try:
+            while True:
+                with self._lock:
+                    if not self._q:
+                        self._flushing = False
+                        return
+                    batch = []
+                    while self._q and len(batch) < 256:
+                        batch.append(self._q.popleft())
+                entries = [entry for entry, _ in batch]
+                futs = [fut for _, fut in batch]
+                if _time.monotonic() < self._degraded_until:
+                    # breaker open: degraded no-persist — drop and count
+                    self._dropped += len(batch)
+                    for fut in futs:
+                        self._ack(fut, False)
+                    continue
+                try:
+                    await self._conn.request(
+                        "kv_put",
+                        {"cluster_id": self.cluster_id, "entries": entries},
+                        timeout=cfg.gcs_kv_put_timeout_s,
+                    )
+                    for fut in futs:
+                        self._ack(fut, True)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self._dropped += len(batch)
+                    for fut in futs:
+                        self._ack(fut, False)
+                    self._degraded_until = (
+                        _time.monotonic() + cfg.gcs_kv_breaker_cooldown_s
+                    )
+                    log.warning(
+                        "remote KV put failed (%s); persistence degraded "
+                        "for %.0fs (%d mutations dropped so far)",
+                        e, cfg.gcs_kv_breaker_cooldown_s, self._dropped,
+                    )
+        except BaseException:
+            with self._lock:
+                self._flushing = False
+            raise
 
     def close(self) -> None:
+        # bounded drain: a clean shutdown persists everything queued; a
+        # degraded/hung server gives up after the put timeout instead of
+        # wedging GCS teardown
+        import time as _time
+
+        deadline = _time.monotonic() + self._cfg().gcs_kv_put_timeout_s
+        while _time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._q and not self._flushing
+            if idle or _time.monotonic() < self._degraded_until:
+                break
+            _time.sleep(0.01)
         self._io.stop()
 
 

@@ -213,6 +213,9 @@ class LocalObjectStore:
                 self._sizes[object_id] = written
                 self._used += written
                 self._lru[object_id] = time.monotonic()
+                # the id exists now: a previously-cached miss must not
+                # mask a later spill-restore of this object
+                self._probe_missed.discard(object_id)
 
     def register_external(self, object_id: ObjectID):
         """Account for an object written directly by a worker process —
@@ -225,6 +228,7 @@ class LocalObjectStore:
         except FileNotFoundError:
             return
         with self._lock:
+            self._probe_missed.discard(object_id)
             if object_id not in self._sizes:
                 try:
                     self._ensure_space_locked(size)
@@ -256,9 +260,20 @@ class LocalObjectStore:
         if self._external is None or object_id in self._probe_missed:
             return False
         try:
-            return self._external.exists(self._spill_key(object_id))
+            found = self._external.exists(self._spill_key(object_id))
         except Exception:
-            return False
+            found = False
+        if not found:
+            # at most ONE external round trip per unseen id (the restore
+            # path's contract): a routine containment check for an object
+            # living on another node must not pay a backend probe forever.
+            # Cleared when the object actually lands here (put /
+            # register_external).
+            with self._lock:
+                if len(self._probe_missed) > 100_000:
+                    self._probe_missed.clear()
+                self._probe_missed.add(object_id)
+        return found
 
     # -- spilling (ray: local_object_manager.h SpillObjects/restore) ---------
     @staticmethod

@@ -65,10 +65,16 @@ def runtime_env_hash(runtime_env: Optional[dict]) -> str:
 class _Worker:
     def __init__(self, proc: subprocess.Popen, job_id: Optional[bytes],
                  env_hash: str = "", log_path: Optional[str] = None,
-                 cidfile: Optional[str] = None, engine: Optional[str] = None):
+                 cidfile: Optional[str] = None, engine: Optional[str] = None,
+                 spawn_id: Optional[str] = None):
         self.proc = proc
         self.job_id = job_id
         self.env_hash = env_hash
+        # spawn key the worker echoes back in register_client: under a
+        # real container engine the in-container worker's os.getpid()
+        # differs from proc.pid (the engine CLIENT's pid), so pid-keyed
+        # matching can never resolve — the spawn id is the identity
+        self.spawn_id = spawn_id
         # container bookkeeping: SIGKILL on the engine client never
         # reaches the container — kill paths must also `engine rm -f`
         self.cidfile = cidfile
@@ -283,6 +289,9 @@ class Raylet:
         # Worker pool (idle queues keyed by runtime-env hash)
         self.idle_workers: Dict[str, deque] = {}
         self.all_workers: Dict[int, _Worker] = {}  # pid -> worker
+        # spawn_id -> worker: the registration key that survives pid
+        # translation through container engines (see _Worker.spawn_id)
+        self._workers_by_spawn: Dict[str, _Worker] = {}
         self.workers_by_client: Dict[str, _Worker] = {}
         self.local_actors: Dict[bytes, _Worker] = {}
         self.actor_addr_cache: Dict[bytes, tuple] = {}
@@ -856,7 +865,16 @@ class Raylet:
                          job_id=p.get("job_id"))
         self.clients[p["client_id"]] = conn
         if p["kind"] == "worker":
-            w = self.all_workers.get(p.get("pid"))
+            # Spawn-id first: a containerized worker reports its
+            # IN-CONTAINER pid, which differs from the engine-client pid
+            # all_workers is keyed by (conmon/containerd-shim reparenting
+            # — even --pid=host doesn't preserve it). Pid matching stays
+            # as the fallback for pre-fix workers mid rolling upgrade.
+            w = None
+            if p.get("spawn_id"):
+                w = self._workers_by_spawn.get(p["spawn_id"])
+            if w is None:
+                w = self.all_workers.get(p.get("pid"))
             if w is not None:
                 w.conn = conn
                 w.client_id = p["client_id"]
@@ -897,6 +915,8 @@ class Raylet:
         if w is None:
             return
         self.all_workers.pop(w.proc.pid, None)
+        if w.spawn_id:
+            self._workers_by_spawn.pop(w.spawn_id, None)
         # record the fate so lease holders can ask WHY their direct conn
         # dropped (e.g. surface the OOM kill instead of a generic loss)
         if w.oom_killed:
@@ -1557,6 +1577,13 @@ class Raylet:
                 # trigger so the worker skips sitecustomize's jax import
                 env.pop("PALLAS_AXON_POOL_IPS", None)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # explicit spawn key (RAY_TPU_ prefix rides the container env
+        # filter): the worker echoes it in register_client so the match
+        # works even when the engine translates pids
+        import uuid as _uuid
+
+        spawn_id = _uuid.uuid4().hex
+        env["RAY_TPU_WORKER_SPAWN_ID"] = spawn_id
         # workers bind their direct-push server to the same host the
         # raylet advertises in lease grants and actor direct_addrs
         env["RAY_TPU_NODE_IP"] = self.host
@@ -1643,8 +1670,9 @@ class Raylet:
         w = _Worker(proc, job_id, env_hash=runtime_env_hash(runtime_env),
                     log_path=log_file, cidfile=cidfile,
                     engine=(container.get("engine") or cfg.container_runtime)
-                    if container else None)
+                    if container else None, spawn_id=spawn_id)
         self.all_workers[proc.pid] = w
+        self._workers_by_spawn[spawn_id] = w
         ehash = w.env_hash
         self._workers_starting[ehash] = \
             self._workers_starting.get(ehash, 0) + 1
@@ -1661,6 +1689,7 @@ class Raylet:
             )
             w.kill_process()  # reaches the container too, if any
             self.all_workers.pop(proc.pid, None)
+            self._workers_by_spawn.pop(spawn_id, None)
             return None
         finally:
             self._workers_starting[ehash] -= 1
@@ -2406,6 +2435,74 @@ class Raylet:
 
         dumps = list(await asyncio.gather(*[dump(w) for w in live]))
         return {"node_id": self.node_id, "workers": dumps}
+
+    # -- on-demand profiling fan-out (profiler.py) ---------------------
+    def _profiler(self):
+        svc = getattr(self, "_profiler_svc", None)
+        if svc is None:
+            from ray_tpu._private import profiler
+
+            svc = self._profiler_svc = profiler.ProfilerService(
+                role="raylet"
+            )
+        return svc
+
+    async def rpc_profile_start(self, conn: Connection, p):
+        return self._profiler().start(p or {})
+
+    async def rpc_profile_stop(self, conn: Connection, p):
+        out = self._profiler().stop(p or {})
+        out["node_id"] = self.node_id
+        return out
+
+    async def rpc_profile_status(self, conn: Connection, p):
+        return self._profiler().status()
+
+    async def rpc_profile_node(self, conn: Connection, p):
+        """Profile every live worker on this node (plus the raylet
+        itself) for one window, CONCURRENTLY — each worker runs its own
+        start/sample/stop session and the results come back as one list
+        (the GCS merges node lists cluster-wide)."""
+        p = dict(p or {})
+        duration = min(float(p.get("duration") or 5.0),
+                       cfg.profiler_max_duration_s)
+        p["duration"] = duration
+        actor_filter = p.get("actor_id")
+        if isinstance(actor_filter, str):
+            try:
+                actor_filter = bytes.fromhex(actor_filter)
+            except ValueError:
+                pass
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+        if actor_filter:
+            live = [w for w in live if w.actor_id == actor_filter]
+
+        async def one(w: _Worker):
+            try:
+                out = await w.conn.request(
+                    "profile_run", p, timeout=duration + 30.0
+                )
+            except Exception as e:
+                return {"pid": w.proc.pid, "node_id": self.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+            out.setdefault("node_id", self.node_id)
+            return out
+
+        jobs = [one(w) for w in live]
+        include_self = bool(p.get("include_raylet", True)) \
+            and not actor_filter
+        if include_self:
+            async def self_prof():
+                out = await self._profiler().run(p)
+                out["node_id"] = self.node_id
+                return out
+
+            jobs.append(self_prof())
+        processes = list(await asyncio.gather(*jobs))
+        return {"node_id": self.node_id, "processes": processes}
 
     # ------------------------------------------------------------------
     # placement groups (bundle resources; 2-phase)

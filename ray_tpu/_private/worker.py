@@ -154,6 +154,11 @@ class CoreWorker:
                 {"client_id": self.client_id,
                  "kind": "driver" if is_driver else "worker",
                  "job_id": self.job_id, "pid": os.getpid(),
+                 # echo the raylet's spawn key: containerized workers
+                 # report a pid the raylet never saw (the engine client's
+                 # pid differs from the in-container worker's), so the
+                 # raylet matches its _Worker record by this key first
+                 "spawn_id": os.environ.get("RAY_TPU_WORKER_SPAWN_ID"),
                  "direct_port": direct_port},
             )
         )
@@ -1490,6 +1495,43 @@ class CoreWorker:
             "current_task": task.hex()[:16] if task else None,
             "threads": out,
         }
+
+    # -- on-demand profiling (profiler.py; ray parity: dashboard
+    # reporter's py-spy/memray attach, here in-process) ------------------
+    def _profiler(self):
+        svc = getattr(self, "_profiler_svc", None)
+        if svc is None:
+            from ray_tpu._private import profiler
+
+            svc = self._profiler_svc = profiler.ProfilerService(
+                role="driver" if self.is_driver else "worker"
+            )
+        return svc
+
+    async def rpc_profile_start(self, conn: Connection, p):
+        return self._profiler().start(p or {})
+
+    async def rpc_profile_stop(self, conn: Connection, p):
+        return self._annotate_profile(self._profiler().stop(p or {}))
+
+    async def rpc_profile_status(self, conn: Connection, p):
+        return self._profiler().status()
+
+    async def rpc_profile_run(self, conn: Connection, p):
+        """start -> sleep(duration) -> stop in ONE request: the raylet's
+        node fan-out holds no per-worker session state, so a connection
+        loss mid-window cannot strand a running profiler (it self-stops
+        at the duration)."""
+        return self._annotate_profile(await self._profiler().run(p or {}))
+
+    def _annotate_profile(self, out: dict) -> dict:
+        out["client_id"] = self.client_id
+        out["node_id"] = self.node_id
+        ex = getattr(self, "executor", None)
+        if ex is not None and getattr(ex, "actor_spec", None) is not None:
+            out["actor_id"] = ex.actor_spec.actor_id.hex()
+            out["actor_class"] = ex.actor_spec.name
+        return out
 
     async def rpc_pubsub(self, conn: Connection, p):
         self._dispatch_pubsub(p["channel"], p["message"])

@@ -9,6 +9,8 @@ hit the agent directly.
 Routes:
   GET /api/v0/node    — node stats (via the local raylet's node_stats RPC)
   GET /api/v0/stacks  — local workers' thread dumps
+  GET /api/v0/profile?kind=cpu|mem&duration=N — node-local profiling
+      window (raylet + its workers; see _private/profiler.py)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
 """
@@ -51,6 +53,28 @@ class Agent:
     async def stacks(self, request):
         conn = await self._raylet()
         return _json(await conn.request("node_stacks", {}, timeout=30))
+
+    async def profile(self, request):
+        """Node-local profiling window (this raylet + its workers) —
+        the per-node analog of the head's /api/profile/*:
+        ?kind=cpu|mem&duration=&hz=."""
+        q = request.query
+        kind = q.get("kind", "cpu")
+        if kind not in ("cpu", "mem"):
+            return _json({"error": "kind must be cpu or mem"}, status=400)
+        try:
+            duration = min(float(q.get("duration", "2")), 60.0)
+            hz = float(q["hz"]) if q.get("hz") else None
+        except ValueError:
+            return _json({"error": "duration and hz must be numbers"},
+                         status=400)
+        payload = {"kind": kind, "duration": duration}
+        if hz is not None:
+            payload["hz"] = hz
+        conn = await self._raylet()
+        reply = await conn.request("profile_node", payload,
+                                   timeout=duration + 45)
+        return _json(reply)
 
     async def logs(self, request):
         log_dir = os.path.join(self.session_dir, "logs")
@@ -97,6 +121,7 @@ async def amain(args) -> None:
     app = web.Application()
     app.router.add_get("/api/v0/node", agent.node)
     app.router.add_get("/api/v0/stacks", agent.stacks)
+    app.router.add_get("/api/v0/profile", agent.profile)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
     runner = web.AppRunner(app)

@@ -128,6 +128,63 @@ def _build_app():
         )
         return _json_response(out)
 
+    @routes.get("/api/profile/cpu")
+    async def profile_cpu(request):
+        """On-demand cluster CPU flamegraph (ray parity: the dashboard's
+        py-spy attach). ?duration=&hz=&node_id=&actor_id=&format=
+        json|speedscope|collapsed."""
+        from ray_tpu.util import profiling
+
+        q = request.query
+        try:
+            duration = min(float(q.get("duration", 2.0)), 60.0)
+            hz = float(q["hz"]) if q.get("hz") else None
+        except ValueError:
+            return _json_response(
+                {"error": "duration and hz must be numbers"}, status=400)
+        fmt = q.get("format", "json")
+
+        def run():
+            return profiling.profile_cpu(
+                duration=duration,
+                hz=hz,
+                node_id=q.get("node_id") or None,
+                actor_id=q.get("actor_id") or None,
+                include_gcs=q.get("include_gcs") in ("1", "true"),
+            )
+
+        prof = await asyncio.get_running_loop().run_in_executor(None, run)
+        if fmt == "speedscope":
+            return _json_response(prof.speedscope())
+        if fmt == "collapsed":
+            return web.Response(text=prof.collapsed(),
+                                content_type="text/plain")
+        return _json_response(prof.raw)
+
+    @routes.get("/api/profile/memory")
+    async def profile_memory(request):
+        """On-demand cluster memory diff (tracemalloc top-N sites).
+        ?duration=&node_id=&actor_id=."""
+        from ray_tpu.util import profiling
+
+        q = request.query
+        try:
+            duration = min(float(q.get("duration", 2.0)), 60.0)
+        except ValueError:
+            return _json_response(
+                {"error": "duration must be a number"}, status=400)
+
+        def run():
+            return profiling.profile_memory(
+                duration=duration,
+                node_id=q.get("node_id") or None,
+                actor_id=q.get("actor_id") or None,
+                include_gcs=q.get("include_gcs") in ("1", "true"),
+            )
+
+        prof = await asyncio.get_running_loop().run_in_executor(None, run)
+        return _json_response(prof.raw)
+
     @routes.get("/api/v0/cluster_resources")
     async def cluster_resources(request):
         import ray_tpu

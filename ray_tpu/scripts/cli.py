@@ -202,6 +202,59 @@ def cmd_stack(args):
     ray_tpu.shutdown()
 
 
+def cmd_profile(args):
+    """ray parity: the dashboard's py-spy/memray attach, as a CLI — one
+    profiling window fanned out cluster-wide (or per node/actor), merged
+    and written as speedscope JSON / collapsed stacks."""
+    import ray_tpu
+    from ray_tpu.util import profiling
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    try:
+        if args.kind == "cpu":
+            prof = profiling.profile_cpu(
+                duration=args.duration, hz=args.hz, node_id=args.node,
+                actor_id=args.actor, include_gcs=args.include_gcs,
+            )
+            if args.task:
+                prof = prof.filter(args.task)
+            out = args.output or \
+                f"profile-cpu-{int(time.time())}.speedscope.json"
+            prof.save(out, format=args.format)
+            print(f"{prof.samples} samples from "
+                  f"{len(prof.processes)} processes -> {out}")
+            for p in prof.errors:
+                print(f"  ! {p.get('node_id', '?')[:12]}: {p['error']}")
+            for proc in prof.processes:
+                extra = f" actor={proc['actor_id'][:12]}" \
+                    if proc.get("actor_id") else ""
+                print(f"  {proc.get('role', '?'):7s} pid={proc.get('pid')} "
+                      f"node={str(proc.get('node_id', ''))[:8]} "
+                      f"samples={proc.get('samples')} "
+                      f"hz={proc.get('effective_hz')}"
+                      f"{' THROTTLED' if proc.get('throttled') else ''}"
+                      f"{extra}")
+            print("top stacks (leaf <- root):")
+            for stack, count in prof.top(args.top):
+                frames = stack.split(";")
+                print(f"  {count:6d}  {' <- '.join(reversed(frames[-3:]))}")
+        else:
+            prof = profiling.profile_memory(
+                duration=args.duration, node_id=args.node,
+                actor_id=args.actor, include_gcs=args.include_gcs,
+            )
+            if args.output:
+                prof.save(args.output)
+                print(f"memory profile -> {args.output}")
+            print(f"top allocation sites over {args.duration:.0f}s "
+                  f"({len(prof.processes)} processes):")
+            for s in prof.top(args.top):
+                print(f"  {s['size_diff_bytes'] / 1024:+10.1f} KiB "
+                      f"({s['count_diff']:+d} blocks)  {s['site']}")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_events(args):
     import ray_tpu
     from ray_tpu.util import events as ev
@@ -442,6 +495,31 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--node-id")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser(
+        "profile",
+        help="on-demand cluster profiling: CPU flamegraphs / memory diffs",
+    )
+    p.add_argument("kind", choices=["cpu", "mem"])
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="sampling window in seconds (default 5)")
+    p.add_argument("--hz", type=float,
+                   help="CPU sampling rate (default: profiler_default_hz)")
+    p.add_argument("--node", help="node id (prefix ok): one node only")
+    p.add_argument("--actor", help="actor id hex: that actor's worker only")
+    p.add_argument("--task", help="filter merged stacks to this substring "
+                                  "(task name / function / id)")
+    p.add_argument("--include-gcs", action="store_true",
+                   help="profile the GCS process too")
+    p.add_argument("-o", "--output",
+                   help="output path (default profile-cpu-<ts>."
+                        "speedscope.json)")
+    p.add_argument("--format", choices=["speedscope", "collapsed", "json"],
+                   help="cpu output format (default by extension)")
+    p.add_argument("--top", type=int, default=10,
+                   help="stacks/sites to print (default 10)")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("events", help="show structured cluster events")
     p.add_argument("--address")

@@ -42,6 +42,11 @@ class Request:
     # route prefix the proxy matched (informs ASGI root_path so a mounted
     # FastAPI app's routes resolve relative to its deployment route)
     route_prefix: str = ""
+    # the query string as received on the wire: duplicate parameters
+    # (?tag=a&tag=b) and percent-encoding survive only here — the parsed
+    # ``query`` dict collapses duplicates. ASGI ingress forwards this
+    # verbatim; None means "built by hand", re-encode from ``query``.
+    raw_query_string: Optional[str] = None
 
     def json(self) -> Any:
         return _json.loads(self.body or b"null")

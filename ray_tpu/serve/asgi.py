@@ -83,6 +83,12 @@ class ASGIAppRunner:
             # uvicorn --root-path convention: the app sees its own paths,
             # root_path records where it is mounted
             path = path[len(prefix):] or "/"
+        # raw wire form when the proxy carried it (duplicate params and
+        # percent-encoding intact); urlencode of the parsed dict only as
+        # the fallback for hand-built envelopes
+        raw_qs = getattr(request, "raw_query_string", None)
+        query_string = raw_qs.encode() if raw_qs is not None \
+            else urlencode(request.query or {}).encode()
         scope = {
             "type": "http",
             "asgi": {"version": "3.0", "spec_version": "2.3"},
@@ -92,7 +98,7 @@ class ASGIAppRunner:
             "path": path,
             "raw_path": quote(path).encode(),
             "root_path": prefix,
-            "query_string": urlencode(request.query or {}).encode(),
+            "query_string": query_string,
             "headers": [
                 (k.lower().encode(), str(v).encode())
                 for k, v in (request.headers or {}).items()

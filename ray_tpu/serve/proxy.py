@@ -451,6 +451,9 @@ class HTTPProxy:
             headers=dict(request.headers),
             body=body,
             route_prefix="" if _prefix == "/" else _prefix,
+            # verbatim wire form: duplicate params + percent-encoding
+            # must reach the mounted ASGI app intact
+            raw_query_string=request.query_string,
         )
         key = (app_name, ingress)
         handle = self._handles.get(key)

@@ -28,6 +28,8 @@ __all__ = [
     "get_node_stats",
     "get_stacks",
     "timeline",
+    "profile_cpu",
+    "profile_memory",
 ]
 
 
@@ -167,6 +169,22 @@ def get_node_stats(node_id: str) -> Optional[dict]:
         if node["node_id"] == node_id:
             return _node_request(node, "node_stats")
     return None
+
+
+def profile_cpu(**kwargs):
+    """Cluster-wide sampled CPU profile (ray parity: the dashboard's
+    py-spy flamegraph attach). See ray_tpu.util.profiling.profile_cpu."""
+    from ray_tpu.util import profiling
+
+    return profiling.profile_cpu(**kwargs)
+
+
+def profile_memory(**kwargs):
+    """Cluster-wide tracemalloc memory diff (ray parity: the dashboard's
+    memray attach). See ray_tpu.util.profiling.profile_memory."""
+    from ray_tpu.util import profiling
+
+    return profiling.profile_memory(**kwargs)
 
 
 def summarize_tasks() -> dict:

@@ -88,6 +88,52 @@ def test_cli_list_and_summary(ray_start_regular):
     assert "ping" in out.stdout
 
 
+def test_dashboard_profile_endpoints(ray_start_regular):
+    """/api/profile/* (ray parity: the dashboard's py-spy attach button):
+    cluster CPU profile in json + speedscope + collapsed formats, memory
+    diff, and the SPA's Profile tab wiring."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def burn(seconds):
+        deadline = time.monotonic() + seconds
+        x = 0
+        while time.monotonic() < deadline:
+            x += 1
+        return x
+
+    ref = burn.remote(4.0)
+    port = start_dashboard()
+    try:
+        prof = _get(port, "/api/profile/cpu?duration=1.0&hz=100")
+        assert prof["kind"] == "cpu"
+        assert prof["samples"] > 0
+        assert {p["role"] for p in prof["processes"]} >= {"worker", "raylet"}
+        ss = _get(port, "/api/profile/cpu?duration=0.5&format=speedscope")
+        assert ss["$schema"].startswith("https://www.speedscope.app/")
+        assert ss["profiles"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/profile/cpu"
+            "?duration=0.5&format=collapsed", timeout=60
+        ) as resp:
+            assert "text/plain" in resp.headers["Content-Type"]
+            collapsed = resp.read().decode()
+        assert all(line.rsplit(" ", 1)[1].isdigit()
+                   for line in collapsed.strip().splitlines() if line)
+        mem = _get(port, "/api/profile/memory?duration=0.5")
+        assert mem["kind"] == "mem"
+        assert isinstance(mem["sites"], list)
+        # the SPA ships the Profile tab and its fetch wiring
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10
+        ) as resp:
+            body = resp.read().decode()
+        assert "runProfile" in body and '"profile"' in body
+    finally:
+        stop_dashboard()
+    ray_tpu.get(ref)
+
+
 def test_dashboard_spa_and_full_api_surface(ray_start_regular):
     """Browser-level smoke without a browser: the SPA page serves with
     its tab structure, and EVERY endpoint the SPA fetches responds with

@@ -90,6 +90,68 @@ def test_externally_spilled_objects_survive_store_restart(tmp_path):
         buf.release()
 
 
+class _CountingBackend:
+    """exists() counter — the probe-budget contract under test."""
+
+    def __init__(self):
+        self.exists_calls = 0
+        self.present = set()
+
+    def exists(self, key):
+        self.exists_calls += 1
+        return key in self.present
+
+    def spill(self, key, local_path):
+        self.present.add(key)
+
+    def restore(self, key, local_path):
+        return False
+
+    def delete(self, key):
+        self.present.discard(key)
+
+
+def test_contains_probes_external_backend_at_most_once(tmp_path):
+    """ADVICE item: contains() for an id the backend doesn't hold must
+    cost at most ONE external round trip (the restore path's documented
+    contract) — routine containment checks for objects living on other
+    nodes were paying a backend head per call."""
+    store = LocalObjectStore(str(tmp_path / "shm"), 1024 * 1024,
+                             f"mocks3://{tmp_path}/remote")
+    backend = _CountingBackend()
+    store._external = backend
+    oid = ObjectID(b"\x07" * 28)
+    for _ in range(5):
+        assert not store.contains(oid)
+    assert backend.exists_calls == 1  # first miss cached, 4 hits free
+    # the object landing locally clears the cached miss: a later spill of
+    # THIS id is probeable again
+    payload = b"x" * 128
+    store.put(oid, b"meta", [payload], len(payload))
+    assert store.contains(oid)  # local hit, no probe
+    store.delete(oid)
+    backend.present.add(oid.hex() + ".obj")
+    assert store.contains(oid)  # re-probed and found externally
+    assert backend.exists_calls == 2
+
+
+def test_register_external_clears_cached_probe_miss(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "shm"), 1024 * 1024,
+                             f"mocks3://{tmp_path}/remote")
+    backend = _CountingBackend()
+    store._external = backend
+    oid = ObjectID(b"\x08" * 28)
+    assert not store.contains(oid)
+    assert oid in store._probe_missed
+    # a worker writes the object directly into shm and registers it
+    from ray_tpu._private.object_store import write_object
+
+    write_object(str(tmp_path / "shm"), oid, b"m", [b"data"], 4)
+    store.register_external(oid)
+    assert oid not in store._probe_missed
+    assert store.contains(oid)
+
+
 def test_cluster_spills_through_plugin_scheme(tmp_path, monkeypatch):
     """e2e: a real cluster configured with the plugin scheme spills under
     memory pressure and restores on get (the IO-worker-style path)."""

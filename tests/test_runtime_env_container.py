@@ -80,6 +80,72 @@ os.execv({sys.executable!r}, [{sys.executable!r}] + args[i + 1:])
     return str(script), str(record)
 
 
+@pytest.fixture
+def forking_engine(tmp_path):
+    """Fake engine that FORKS the worker (subprocess) instead of exec'ing
+    it in place — the real podman/docker shape, where the worker's
+    os.getpid() differs from the engine client's pid the raylet spawned.
+    Registration must therefore resolve via the spawn key, not the pid."""
+    record = tmp_path / "fork_engine_calls.jsonl"
+    script = tmp_path / "forking_engine.py"
+    script.write_text(f"""#!{sys.executable}
+import json, os, subprocess, sys
+args = sys.argv[1:]
+with open({str(record)!r}, "a") as f:
+    f.write(json.dumps(args) + "\\n")
+# env rides -e K=V flags, exactly like a real engine invocation
+env = dict(os.environ)
+i = 0
+while i < len(args):
+    if args[i] == "-e" and "=" in args[i + 1]:
+        k, v = args[i + 1].split("=", 1)
+        env[k] = v
+        i += 2
+    else:
+        i += 1
+# run the inner worker command as a CHILD (pid != our pid), like conmon
+j = args.index("python")
+rc = subprocess.run([sys.executable] + args[j + 1:], env=env).returncode
+sys.exit(rc)
+""")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script), str(record)
+
+
+def test_worker_registers_through_forking_engine(forking_engine, monkeypatch):
+    """ADVICE high: with a forking engine the worker's reported pid never
+    matches the raylet's engine-client pid — before the spawn-id fix,
+    registration timed out and the raylet looped spawning containers."""
+    engine, record = forking_engine
+    monkeypatch.setenv("RAY_TPU_container_runtime", engine)
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"container": {"image": "fake:latest"}})
+        def whoami():
+            return os.getpid(), os.getppid(), \
+                os.environ.get("RAY_TPU_WORKER_SPAWN_ID")
+
+        pid, ppid, spawn_id = ray_tpu.get(whoami.remote(), timeout=120)
+        assert spawn_id, "spawn key did not reach the containerized worker"
+        with open(record) as f:
+            calls = [json.loads(line) for line in f]
+        assert calls, "worker never went through the engine"
+        # the pid mismatch was actually exercised: the worker is a CHILD
+        # of the engine client, so its pid differs from what the raylet
+        # keyed all_workers by
+        assert any(f"RAY_TPU_WORKER_SPAWN_ID={spawn_id}" in arg
+                   for call in calls for arg in call)
+        assert pid != ppid
+        # and the registered worker serves follow-up tasks normally
+        @ray_tpu.remote(runtime_env={"container": {"image": "fake:latest"}})
+        def again():
+            return "ok"
+
+        assert ray_tpu.get(again.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_worker_runs_through_engine_end_to_end(fake_engine, monkeypatch):
     engine, record = fake_engine
     monkeypatch.setenv("RAY_TPU_container_runtime", engine)

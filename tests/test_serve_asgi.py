@@ -113,6 +113,68 @@ def test_asgi_ingress_end_to_end(serve_cluster):
         assert json.loads(e.read()) == {"detail": "Not Found"}
 
 
+def test_query_string_fidelity_through_proxy(serve_cluster):
+    """ADVICE item: the scope's query_string must be the WIRE form —
+    duplicate parameters (?tag=a&tag=b) and percent-encoding previously
+    collapsed through the parsed Dict[str, str] + urlencode round trip."""
+    import urllib.request
+    from urllib.parse import parse_qs
+
+    app = _make_app()
+
+    @serve.deployment
+    @serve.ingress(app)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), name="qsapi", route_prefix="/qs")
+    base = f"http://127.0.0.1:{serve.http_port()}"
+
+    url = base + "/qs/items/7?tag=a&tag=b&q=a%2Fb%20c&empty="
+    with urllib.request.urlopen(url) as r:
+        out = json.loads(r.read())
+    parsed = parse_qs(out["qs"], keep_blank_values=True)
+    # duplicates survive (the dict round trip kept only the last value)
+    assert parsed["tag"] == ["a", "b"], out["qs"]
+    # percent-encoded reserved chars decode to the original value
+    assert parsed["q"] == ["a/b c"], out["qs"]
+    assert parsed["empty"] == [""], out["qs"]
+    # and the raw string still carries both tag occurrences verbatim
+    assert out["qs"].count("tag=") == 2, out["qs"]
+
+
+def test_asgi_query_string_fallback_without_raw():
+    """Hand-built Request envelopes (no proxy) still produce a usable
+    query_string from the parsed dict."""
+    import asyncio
+
+    from ray_tpu.serve._common import Request
+    from ray_tpu.serve.asgi import ASGIAppRunner
+
+    seen = {}
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            return
+        seen["qs"] = scope["query_string"]
+        await receive()
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    runner = ASGIAppRunner(app)
+    req = Request(method="GET", path="/x", query={"a": "1", "b": "2"})
+    assert req.raw_query_string is None  # hand-built: no wire form
+    resp = asyncio.run(runner(req))
+    assert resp.status == 200
+    assert seen["qs"] == b"a=1&b=2"
+    # with the wire form present it wins, verbatim
+    req2 = Request(method="GET", path="/x", query={"t": "b"},
+                   raw_query_string="t=a&t=b")
+    asyncio.run(runner(req2))
+    assert seen["qs"] == b"t=a&t=b"
+
+
 def test_asgi_ingress_composes_with_class_state(serve_cluster):
     """The decorated class's own __init__ still runs (the reference
     pattern: FastAPI routes defined on the class via app.get used with
