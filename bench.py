@@ -181,12 +181,38 @@ def _profiler_overhead_main():
     os._exit(0)
 
 
+def _metrics_overhead_main():
+    """BENCH_METRICS_OVERHEAD=1: the metrics plane's acceptance number —
+    self-measured instrumentation share of the sync-task hot path, gated
+    <2%, plus the paired enabled/disabled throughput A/B (reported, not
+    gated: this box's A/A noise floor is ~1.8x). Emits ONE JSON line,
+    same contract as the default bench path."""
+    import ray_tpu
+    from ray_tpu.util.metrics import metrics_overhead_bench
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        out = metrics_overhead_bench()
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": "metrics_overhead_self_fraction",
+        "value": out["self_fraction"],
+        "unit": "fraction",
+        "vs_baseline": 1.0 if out["self_fraction"] < 0.02 else 0.0,
+        "detail": out,
+    }), flush=True)
+    os._exit(0)
+
+
 def main():
     signal.signal(signal.SIGTERM, _emit_and_exit)
     threading.Thread(target=_watchdog_thread, daemon=True).start()
 
     if os.environ.get("BENCH_PROFILER_OVERHEAD"):
         _profiler_overhead_main()
+    if os.environ.get("BENCH_METRICS_OVERHEAD"):
+        _metrics_overhead_main()
 
     on_tpu = _tpu_reachable()
 

@@ -129,7 +129,15 @@ _flag("profiler_mem_frames", int, 8)
 _flag("gcs_kv_put_timeout_s", float, 5.0)
 _flag("gcs_kv_queue_max", int, 10_000)
 _flag("gcs_kv_breaker_cooldown_s", float, 30.0)
-# Metrics / events
+# Metrics / events (metrics_core.py: per-process counters/gauges/log2
+# histograms behind the metrics_snapshot fan-out + /metrics scrape)
+_flag("metrics_enabled", bool, True)  # master switch (overhead A/B lane)
+# dashboard head: cadence + depth of the in-head snapshot ring buffer the
+# SPA Metrics tab draws its sparkline time-series from
+_flag("metrics_history_interval_s", float, 5.0)
+_flag("metrics_history_len", int, 120)
+# cluster scrape budget: per-node fan-out timeout inside metrics_cluster
+_flag("metrics_scrape_timeout_s", float, 10.0)
 _flag("metrics_report_interval_s", float, 2.0)
 _flag("task_events_buffer_size", int, 10_000)
 _flag("event_stats", bool, True)

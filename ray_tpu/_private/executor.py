@@ -32,6 +32,43 @@ from ray_tpu._private.ids import ObjectID, TaskID
 logger = logging.getLogger(__name__)
 
 
+# --- runtime metrics: per-actor-class queue-wait + run-time ------------
+class _ExecMetrics:
+    __slots__ = ("run", "wait", "_children")
+
+    def __init__(self):
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        self.run = reg.histogram(
+            "worker_task_run_seconds",
+            "User-code execution time per task, by actor class "
+            "('task' for plain tasks)", scale=mc.LATENCY)
+        self.wait = reg.histogram(
+            "worker_task_queue_wait_seconds",
+            "Executor queue wait: request arrival to user-code start "
+            "(includes the actor sequence gate)", scale=mc.LATENCY)
+        self._children: Dict[str, tuple] = {}
+
+    def record(self, kind: str, wait_s: float, run_s: float):
+        pair = self._children.get(kind)
+        if pair is None:
+            pair = self._children[kind] = (
+                self.wait.labels(kind=kind), self.run.labels(kind=kind))
+        pair[0].record(wait_s)
+        pair[1].record(run_s)
+
+
+_MX: Optional[_ExecMetrics] = None
+
+
+def _exec_metrics() -> _ExecMetrics:
+    global _MX
+    if _MX is None:
+        _MX = _ExecMetrics()
+    return _MX
+
+
 class _CallerQueue:
     """Per-caller sequence gate (ray: sequential_actor_submit_queue.h).
 
@@ -119,6 +156,7 @@ class TaskExecutor:
 
     # ------------------------------------------------------------------
     async def execute_task(self, spec: TaskSpec):
+        t_in = time.perf_counter()
         is_actor_task = spec.actor_id is not None and not spec.actor_creation
         sem = None
         if is_actor_task and (self._group_sems or spec.concurrency_group):
@@ -137,8 +175,8 @@ class TaskExecutor:
             await self._await_turn(spec.caller_id, spec.seq_no)
         if sem is not None:
             async with sem:
-                return await self._execute_gated(spec, is_actor_task)
-        return await self._execute_gated(spec, is_actor_task)
+                return await self._execute_gated(spec, is_actor_task, t_in)
+        return await self._execute_gated(spec, is_actor_task, t_in)
 
     # ------------------------------------------------------------------
     def _batchable(self, spec: TaskSpec) -> bool:
@@ -210,6 +248,7 @@ class TaskExecutor:
         no ordering contract and skip the gate."""
         loop = asyncio.get_running_loop()
         start = time.time()
+        t_in = time.perf_counter()
         gated = specs[0].actor_id is not None
         if gated:
             await self._await_turn(specs[0].caller_id, specs[0].seq_no)
@@ -237,6 +276,8 @@ class TaskExecutor:
                 for spec in specs
             ]
 
+            kind = self._metric_kind(specs[0])
+
             def run_all():
                 for idx, (spec, r, call) in enumerate(
                     zip(specs, resolved, calls)
@@ -248,6 +289,7 @@ class TaskExecutor:
                         continue
                     args, kwargs = r[1]
                     self.current_task_id = spec.task_id
+                    t_start = time.perf_counter()
                     try:
                         with profiler.tag_current_thread.for_spec(spec):
                             out = (idx, True, call(*args, **kwargs))
@@ -255,6 +297,13 @@ class TaskExecutor:
                         out = (idx, False, e)
                     finally:
                         self.current_task_id = None
+                        # wait = batch arrival at the executor to THIS
+                        # item's user-code start (seq gate + arg resolve
+                        # + time behind earlier batch items), matching
+                        # the non-batch path's arrival-to-start contract
+                        _exec_metrics().record(
+                            kind, t_start - t_in,
+                            time.perf_counter() - t_start)
                     loop.call_soon_threadsafe(done_q.put_nowait, out)
 
             pool_fut = loop.run_in_executor(self.pool, run_all)
@@ -282,7 +331,8 @@ class TaskExecutor:
                 for _ in range(len(specs) - delivered):
                     await self._advance_turn(specs[0].caller_id)
 
-    async def _execute_gated(self, spec: TaskSpec, is_actor_task: bool):
+    async def _execute_gated(self, spec: TaskSpec, is_actor_task: bool,
+                             t_in: Optional[float] = None):
         try:
             ctx = getattr(spec, "tracing_ctx", None)
             if ctx is not None:
@@ -303,14 +353,14 @@ class TaskExecutor:
                 }
                 start = time.time()
                 try:
-                    return await self._execute(spec, is_actor_task)
+                    return await self._execute(spec, is_actor_task, t_in)
                 finally:
                     tracing.record_remote_span(
                         f"task::{spec.name}", start, time.time(), ctx,
                         attributes={"task_id": spec.task_id.hex()[:16]},
                         span_id=exec_span_id,
                     )
-            return await self._execute(spec, is_actor_task)
+            return await self._execute(spec, is_actor_task, t_in)
         finally:
             if is_actor_task and self.max_concurrency == 1:
                 await self._advance_turn(spec.caller_id)
@@ -340,7 +390,13 @@ class TaskExecutor:
         if fut is not None and not fut.done():
             fut.set_result(None)
 
-    async def _execute(self, spec: TaskSpec, is_actor_task: bool):
+    def _metric_kind(self, spec: TaskSpec) -> str:
+        if spec.actor_id is not None and self.actor_spec is not None:
+            return self.actor_spec.name or "actor"
+        return "task"
+
+    async def _execute(self, spec: TaskSpec, is_actor_task: bool,
+                       t_in: Optional[float] = None):
         loop = asyncio.get_running_loop()
         start = time.time()
         self.current_task_id = spec.task_id
@@ -356,6 +412,7 @@ class TaskExecutor:
         except Exception as e:
             sv = serialization.serialize_error(e, spec.name)
             return self._error_result(sv, app_error=False)
+        t_run = time.perf_counter()
         try:
             ctx = getattr(spec, "tracing_ctx", None)
             if is_actor_task:
@@ -393,6 +450,11 @@ class TaskExecutor:
             return self._error_result(sv, app_error=True)
         finally:
             self.current_task_id = None
+            _exec_metrics().record(
+                self._metric_kind(spec),
+                (t_run - t_in) if t_in is not None else 0.0,
+                time.perf_counter() - t_run,
+            )
         return self._package_returns(spec, value, start)
 
     def _load_fn(self, func_blob: bytes):

@@ -251,6 +251,43 @@ def _load_file(path: str, now: float):
     _rebuild()
 
 
+_injection_counters: dict = {}
+
+
+def record_injection(kind: str, method: str):
+    """Account one injected fault: a metric
+    (``rpc_faults_injected_total{kind=...}``) plus — when tracing is on —
+    a point span in the task-event log, so a chaos-lane failure
+    correlates with the exact faults injected around it in the SAME
+    cluster snapshot (metrics + timeline). Called by rpcio at the
+    injection sites; must never raise into the send path."""
+    try:
+        c = _injection_counters.get(kind)
+        if c is None:
+            from ray_tpu._private import metrics_core
+
+            c = _injection_counters[kind] = metrics_core.registry().counter(
+                "rpc_faults_injected_total",
+                "Faults injected by faultsim, by kind",
+            ).labels(kind=kind)
+        c.inc()
+    except Exception:
+        pass
+    try:
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            now = time.time()
+            tracing.record_remote_span(
+                f"faultsim::{kind}", now, now,
+                {"trace_id": f"faultsim-{os.getpid()}", "span_id": "fault"},
+                attributes={"kind": kind, "method": method,
+                            "self_id": _SELF_ID},
+            )
+    except Exception:
+        pass
+
+
 def active_plan() -> Optional[FaultPlan]:
     """The armed plan, or None (the common case, two attribute reads)."""
     global _DISARMED

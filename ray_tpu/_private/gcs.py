@@ -197,9 +197,30 @@ class GcsServer:
         )
         return True
 
+    def _setup_metrics(self):
+        """GCS runtime gauges (metrics_core.py): node liveness + control
+        tables, evaluated at snapshot time. The remote-KV pipeline's
+        queue/breaker gauges register in gcs_store.RemoteKvStore."""
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        nodes = reg.gauge("gcs_node_count", "Cluster nodes by liveness")
+        nodes.labels(state="alive").set_fn(
+            lambda: sum(1 for n in self.nodes.values() if n.alive))
+        nodes.labels(state="dead").set_fn(
+            lambda: sum(1 for n in self.nodes.values() if not n.alive))
+        reg.gauge("gcs_actor_count", "Actor records in the GCS table"
+                  ).set_fn(lambda: len(self.actors))
+        reg.gauge("gcs_placement_group_count", "Placement group records"
+                  ).set_fn(lambda: len(self.pgs))
+        reg.gauge("gcs_subscriber_conns", "Pubsub subscriber connections"
+                  ).set_fn(lambda: sum(len(s)
+                                       for s in self.subscribers.values()))
+
     async def start(self):
         port = await self.server.start()
         faultsim.set_self_id(f"gcs:{port}")
+        self._setup_metrics()
         self._tasks.append(spawn(self._health_loop()))
         if self._recovered:
             self._tasks.append(
@@ -1052,6 +1073,68 @@ class GcsServer:
         merged["duration_s"] = duration
         merged["nodes"] = len(targets)
         return merged
+
+    # ------------------------------------------------------------------
+    # Metrics plane (metrics_core.py): cluster-wide scrape fan-out+merge
+    # ------------------------------------------------------------------
+    async def rpc_metrics_snapshot(self, conn: Connection, p):
+        from ray_tpu._private import metrics_core
+
+        return metrics_core.process_snapshot("gcs")
+
+    async def rpc_metrics_cluster(self, conn: Connection, p):
+        """One cluster-wide scrape: fan to every live raylet (which fans
+        to its workers), every registered DRIVER connection (user metrics
+        live in driver processes; workers are already covered through
+        their raylet), plus this GCS — then merge (sum counters/gauges,
+        merge histogram buckets). Mirrors profile_cluster's shape, but
+        cheap enough to poll: one snapshot is a dict copy per process,
+        no sampling window."""
+        from ray_tpu._private import metrics_core
+
+        timeout = cfg.metrics_scrape_timeout_s
+
+        async def node(nid: str, nconn: Connection):
+            try:
+                reply = await nconn.request("metrics_node", {},
+                                            timeout=timeout)
+                return reply.get("processes") or []
+            except Exception as e:
+                return [{"node_id": nid,
+                         "error": f"{type(e).__name__}: {e}"}]
+
+        async def driver(cid: str, cconn: Connection):
+            try:
+                return [await cconn.request("metrics_snapshot", {},
+                                            timeout=timeout)]
+            except Exception as e:
+                return [{"client_id": cid,
+                         "error": f"{type(e).__name__}: {e}"}]
+
+        jobs = []
+        n_nodes = 0
+        for nid, nconn in list(self.node_conns.items()):
+            info = self.nodes.get(nid)
+            if info is None or not info.alive:
+                continue
+            n_nodes += 1
+            jobs.append(node(nid, nconn))
+        for cid, cconn in list(self.client_conns.items()):
+            if cconn.meta.get("is_driver") and not cconn.closed:
+                jobs.append(driver(cid, cconn))
+        per = await asyncio.gather(*jobs)
+        processes = [proc for plist in per for proc in plist]
+        processes.append(metrics_core.process_snapshot("gcs"))
+        ok = [proc for proc in processes if not proc.get("error")]
+        merged = metrics_core.merge_snapshots(
+            [proc.get("metrics") or {} for proc in ok])
+        return {
+            "merged": merged,
+            "processes": processes,
+            "nodes": n_nodes,
+            "record_calls": sum(proc.get("record_calls", 0) for proc in ok),
+            "errors": [proc for proc in processes if proc.get("error")],
+        }
 
     # ------------------------------------------------------------------
     # Task events (observability; ray: gcs_task_manager.h)

@@ -323,6 +323,30 @@ class RemoteKvStore:
         self._flushing = False
         self._degraded_until = 0.0
         self._dropped = 0
+        self._setup_metrics()
+
+    def _setup_metrics(self):
+        """Snapshot-time gauges over the put pipeline: queue depth,
+        breaker posture, drops (metrics_core.py — zero hot-path cost)."""
+        try:
+            import time as _time
+
+            from ray_tpu._private import metrics_core as mc
+
+            reg = mc.registry()
+            reg.gauge("gcs_kv_put_queue_depth",
+                      "Remote-KV puts queued for the io thread"
+                      ).set_fn(lambda: len(self._q))
+            reg.gauge("gcs_kv_breaker_open",
+                      "1 while the remote-KV circuit breaker holds the "
+                      "degraded no-persist posture").set_fn(
+                lambda: 1.0 if _time.monotonic() < self._degraded_until
+                else 0.0)
+            reg.counter("gcs_kv_puts_dropped_total",
+                        "Puts dropped by overload/breaker"
+                        ).default.set_fn(lambda: self._dropped)
+        except Exception:  # metrics must never break persistence setup
+            pass
 
     def _cfg(self):
         from ray_tpu._private.config import GLOBAL_CONFIG

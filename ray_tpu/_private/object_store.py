@@ -33,6 +33,52 @@ from ray_tpu._private.ids import ObjectID
 _MAGIC = b"RTPUOBJ1"
 _HEADER = 24
 
+# --- runtime metrics (metrics_core.py) ---------------------------------
+# Built lazily; read_object/write_object run in every process (workers
+# write returns directly, raylets serve pulls), so each process's
+# registry sees its own share and the cluster scrape merges them.
+_MX = None
+
+
+class _StoreMetrics:
+    __slots__ = ("put_lat", "put_bytes", "get_lat", "get_bytes",
+                 "ext_hits", "ext_misses", "spills", "restores")
+
+    def __init__(self):
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        self.put_lat = reg.histogram(
+            "object_store_put_latency_seconds",
+            "Object create+seal latency", scale=mc.LATENCY).default
+        self.put_bytes = reg.histogram(
+            "object_store_put_bytes", "Object sizes written",
+            scale=mc.SIZE).default
+        self.get_lat = reg.histogram(
+            "object_store_get_latency_seconds",
+            "Object open+mmap latency", scale=mc.LATENCY).default
+        self.get_bytes = reg.histogram(
+            "object_store_get_bytes", "Object sizes mapped",
+            scale=mc.SIZE).default
+        self.ext_hits = reg.counter(
+            "object_store_external_probe_hits_total",
+            "External spill-backend existence probes that hit").default
+        self.ext_misses = reg.counter(
+            "object_store_external_probe_misses_total",
+            "External spill-backend existence probes that missed").default
+        self.spills = reg.counter(
+            "object_store_spills_total", "Objects spilled out of shm").default
+        self.restores = reg.counter(
+            "object_store_restores_total",
+            "Objects restored from the spill backend").default
+
+
+def _mx() -> "_StoreMetrics":
+    global _MX
+    if _MX is None:
+        _MX = _StoreMetrics()
+    return _MX
+
 
 class ObjectStoreFullError(Exception):
     pass
@@ -81,6 +127,7 @@ def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
     open->lock race against a concurrent pool rename."""
     import fcntl
 
+    t0 = time.perf_counter()
     path = _obj_path(store_dir, object_id)
     try:
         f = open(path, "rb")
@@ -103,6 +150,9 @@ def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
     data_len = int.from_bytes(m[16:24], "little")
     metadata = bytes(m[_HEADER : _HEADER + meta_len])
     data = memoryview(m)[_HEADER + meta_len : _HEADER + meta_len + data_len]
+    mx = _mx()
+    mx.get_lat.record(time.perf_counter() - t0)
+    mx.get_bytes.record(data_len)
     return ObjectBuffer(object_id, metadata, data, _mmap=m, _file=f)
 
 
@@ -126,12 +176,18 @@ def write_object(
     final = _obj_path(store_dir, object_id)
     if os.path.exists(final):
         return 0
+    t0 = time.perf_counter()
     from ray_tpu._private import native_store
 
     if native_store.available():
-        return native_store.write_object(
+        written = native_store.write_object(
             store_dir, object_id.hex(), metadata, buffers, total_data_len
         )
+        if written:
+            mx = _mx()
+            mx.put_lat.record(time.perf_counter() - t0)
+            mx.put_bytes.record(total_data_len)
+        return written
     tmp = final + f".building.{os.getpid()}"
     size = _HEADER + len(metadata) + total_data_len
     with open(tmp, "wb") as f:
@@ -142,6 +198,9 @@ def write_object(
         for buf in buffers:
             f.write(buf)
     os.rename(tmp, final)
+    mx = _mx()
+    mx.put_lat.record(time.perf_counter() - t0)
+    mx.put_bytes.record(total_data_len)
     return size
 
 
@@ -263,6 +322,7 @@ class LocalObjectStore:
             found = self._external.exists(self._spill_key(object_id))
         except Exception:
             found = False
+        (_mx().ext_hits if found else _mx().ext_misses).inc()
         if not found:
             # at most ONE external round trip per unseen id (the restore
             # path's contract): a routine containment check for an object
@@ -298,6 +358,7 @@ class LocalObjectStore:
         self._used -= size
         self._spilled[object_id] = size
         self.spilled_bytes_total += size
+        _mx().spills.inc()
         return True
 
     def restore_if_spilled(self, object_id: ObjectID) -> bool:
@@ -357,6 +418,7 @@ class LocalObjectStore:
             self._used += size
             self._lru[object_id] = time.monotonic()
             self.restored_bytes_total += size
+            _mx().restores.inc()
             return True
 
     # -- lifecycle -----------------------------------------------------------

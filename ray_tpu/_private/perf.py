@@ -30,6 +30,27 @@ def _timeit(name: str, fn: Callable[[], int], warmup: int = 1,
     return name, best
 
 
+def _lat_hist():
+    """Standalone log2 latency histogram (metrics_core) for per-op tail
+    tracking: the sequential benches time EACH op into it so BENCH_CORE
+    carries p50/p95/p99, not just the mean ops/s (tail regressions — a
+    stalled dispatch pass, a GC pause per N ops — are invisible in
+    means). Batched/pipelined benches keep mean-only: a per-op latency
+    inside a 1000-deep pipeline measures queue depth, not the runtime."""
+    from ray_tpu._private import metrics_core as mc
+
+    return mc.Histogram({}, scale=mc.LATENCY)
+
+
+def _lat_summary(h) -> dict:
+    from ray_tpu._private import metrics_core as mc
+
+    qs = mc.hist_quantiles(h._series(), (0.5, 0.95, 0.99))
+    return {"p50_us": round(qs[0.5] * 1e6, 1),
+            "p95_us": round(qs[0.95] * 1e6, 1),
+            "p99_us": round(qs[0.99] * 1e6, 1)}
+
+
 def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
     """Run the suite against an initialized ray_tpu cluster. ``select``
     substring-filters benchmark names; ``small`` shrinks batch sizes (CI)."""
@@ -51,10 +72,16 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         async def aping(self):
             return b"ok"
 
-    def record(name, ops_s, unit="ops/s"):
-        results.append({"benchmark": name, "value": round(ops_s, 1),
-                        "unit": unit})
-        print(f"{name:<42s} {ops_s:>12,.1f} {unit}")
+    def record(name, ops_s, unit="ops/s", lat=None):
+        row = {"benchmark": name, "value": round(ops_s, 1), "unit": unit}
+        tail = ""
+        if lat is not None and lat.count():
+            row.update(_lat_summary(lat))
+            tail = (f"  p50={row['p50_us']:,.0f}us "
+                    f"p95={row['p95_us']:,.0f}us "
+                    f"p99={row['p99_us']:,.0f}us")
+        results.append(row)
+        print(f"{name:<42s} {ops_s:>12,.1f} {unit}{tail}")
 
     benches: Dict[str, Tuple[str, Callable[[], Tuple[str, float]]]] = {}
 
@@ -66,11 +93,15 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
 
     @bench("single_client_tasks_sync", "single client tasks sync")
     def _tasks_sync():
+        h = _lat_hist()
+
         def run():
             for _ in range(batch // 10):
+                t0 = time.perf_counter()
                 ray_tpu.get(nop.remote())
+                h.record(time.perf_counter() - t0)
             return batch // 10
-        return _timeit("single client tasks sync", run)
+        return _timeit("single client tasks sync", run) + (h,)
 
     @bench("single_client_tasks_async", "single client tasks async")
     def _tasks_async():
@@ -83,14 +114,17 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
     def _actor_sync():
         a = Sink.remote()
         ray_tpu.get(a.ping.remote())
+        h = _lat_hist()
 
         def run():
             for _ in range(batch // 10):
+                t0 = time.perf_counter()
                 ray_tpu.get(a.ping.remote())
+                h.record(time.perf_counter() - t0)
             return batch // 10
         out = _timeit("1:1 actor calls sync", run)
         ray_tpu.kill(a)
-        return out
+        return out + (h,)
 
     @bench("actor_calls_async_1_1", "1:1 actor calls async")
     def _actor_async():
@@ -135,19 +169,27 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
 
     @bench("put_small", "small put (100B)")
     def _put_small():
+        h = _lat_hist()
+
         def run():
             for _ in range(batch):
+                t0 = time.perf_counter()
                 ray_tpu.put(b"x" * 100)
+                h.record(time.perf_counter() - t0)
             return batch
-        return _timeit("small put (100B)", run)
+        return _timeit("small put (100B)", run) + (h,)
 
     @bench("put_get_roundtrip", "put+get roundtrip (1KB)")
     def _put_get():
+        h = _lat_hist()
+
         def run():
             for _ in range(batch // 10):
+                t0 = time.perf_counter()
                 ray_tpu.get(ray_tpu.put(b"x" * 1000))
+                h.record(time.perf_counter() - t0)
             return batch // 10
-        return _timeit("put+get roundtrip (1KB)", run)
+        return _timeit("put+get roundtrip (1KB)", run) + (h,)
 
     @bench("put_get_1mb_numpy", "put+get 1MB numpy")
     def _put_get_1mb():
@@ -155,15 +197,18 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         # views) -> shm write -> register -> mmap read -> deserialize
         arr = np.arange(1024 * 1024, dtype=np.uint8)
         n = max(1, batch // 10)
+        h = _lat_hist()
 
         def run():
             got = None
             for _ in range(n):
+                t0 = time.perf_counter()
                 got = ray_tpu.get(ray_tpu.put(arr))
+                h.record(time.perf_counter() - t0)
             assert got.nbytes == arr.nbytes
             del got
             return n
-        return _timeit("put+get 1MB numpy", run)
+        return _timeit("put+get 1MB numpy", run) + (h,)
 
     @bench("actor_call_1mb_arg", "actor call 1MB arg")
     def _actor_1mb_arg():
@@ -225,8 +270,11 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         # 10k-refs teardown otherwise bleeds into put bandwidth)
         gc.collect()
         time.sleep(0.5)
-        name, value = fn()
-        record(name, value, "GB/s" if key == "put_gigabytes" else "ops/s")
+        out = fn()
+        name, value = out[0], out[1]
+        lat = out[2] if len(out) > 2 else None
+        record(name, value, "GB/s" if key == "put_gigabytes" else "ops/s",
+               lat=lat)
     if not results:
         print(f"no benchmarks matched --select {select!r}; available: "
               + ", ".join(benches))

@@ -220,7 +220,8 @@ class _ReadyQueues:
 
 
 class _QueuedTask:
-    __slots__ = ("spec", "resources", "pending_deps", "worker", "sched_cls")
+    __slots__ = ("spec", "resources", "pending_deps", "worker", "sched_cls",
+                 "ready_at")
 
     def __init__(self, spec: TaskSpec, resources: Dict[str, float]):
         self.spec = spec
@@ -230,6 +231,10 @@ class _QueuedTask:
         # computed once: the dispatch loop touches it every pass, and
         # recomputing (a sort) per pass profiled at ~90 calls per task
         self.sched_cls = spec.scheduling_class()
+        # stamped when the task enters the ready queue (placement-latency
+        # histogram measures ready -> dispatched-to-worker); requeues
+        # (push_front) keep the original stamp on purpose
+        self.ready_at = 0.0
 
 
 class Raylet:
@@ -357,11 +362,60 @@ class Raylet:
         self.port = None
         # metrics
         self.counters = {"tasks_dispatched": 0, "tasks_spilled": 0, "objects_pulled": 0}
+        self._setup_metrics()
         # Task state-transition buffer, flushed in batches to the GCS
         # (ray: src/ray/core_worker/task_event_buffer.h:199 — we buffer at
         # the raylet, the chokepoint that sees queue/dispatch/finish for
         # every normal task on this node).
         self._task_events: List[dict] = []
+
+    def _setup_metrics(self):
+        """Register this raylet's runtime gauges (metrics_core.py).
+        Every gauge is a snapshot-time callback — scheduler/pool hot
+        paths pay nothing — tagged with the node id so the cluster merge
+        keeps one series per node (ray parity: src/ray/stats/metric_defs.h
+        scheduler/worker-pool gauges)."""
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        tags = {"node": self.node_id[:12]}
+
+        def gauge(name, desc, fn):
+            reg.gauge(name, desc).labels(**tags).set_fn(fn)
+
+        gauge("raylet_ready_queue_depth",
+              "Tasks ready for dispatch on this node",
+              lambda: len(self.ready))
+        gauge("raylet_waiting_tasks",
+              "Tasks parked waiting on argument fetches",
+              lambda: len(self.waiting))
+        gauge("raylet_infeasible_tasks",
+              "Tasks no cluster node can currently fit",
+              lambda: len(self.infeasible))
+        gauge("raylet_running_tasks", "Tasks executing on this node",
+              lambda: len(self.running))
+        gauge("raylet_worker_pool_size", "Live worker processes",
+              lambda: len(self.all_workers))
+        gauge("raylet_idle_workers", "Idle pooled workers",
+              lambda: sum(len(q) for q in self.idle_workers.values()))
+        gauge("raylet_store_used_bytes", "Local object store usage",
+              self.store.used_bytes)
+        # *_total series must expose TYPE counter (rate() and openmetrics
+        # lint assume it); the raylet already keeps the tallies, so these
+        # are snapshot-time counter callbacks
+        reg.counter("raylet_tasks_dispatched_total",
+                    "Tasks handed to workers").labels(**tags).set_fn(
+            lambda: self.counters["tasks_dispatched"])
+        reg.counter("raylet_tasks_spilled_total",
+                    "Tasks spilled to peer nodes").labels(**tags).set_fn(
+            lambda: self.counters["tasks_spilled"])
+        gauge("raylet_store_spilled_objects",
+              "Objects currently spilled out of shm",
+              lambda: self.store.spilled_stats()["spilled_objects"])
+        self._placement_lat = reg.histogram(
+            "raylet_task_placement_latency_seconds",
+            "Ready-queue entry to worker dispatch", scale=mc.LATENCY,
+        ).labels(**tags)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1245,6 +1299,7 @@ class Raylet:
                 self.dep_waiters.setdefault(oid, []).append(spec.task_id)
                 spawn(self._pull_for_dep(oid))
         else:
+            qt.ready_at = time.perf_counter()
             self.ready.append(qt)
             self._emit_task_event(spec, "PENDING_NODE_ASSIGNMENT")
             self._dispatch_event.set()
@@ -1279,6 +1334,7 @@ class Raylet:
             qt.pending_deps.discard(oid)
             if not qt.pending_deps:
                 del self.waiting[tid]
+                qt.ready_at = time.perf_counter()
                 self.ready.append(qt)
                 self._dispatch_event.set()
 
@@ -1337,6 +1393,9 @@ class Raylet:
                     w.busy_with = qt.spec.task_id
                     self.running[qt.spec.task_id] = qt
                     self.counters["tasks_dispatched"] += 1
+                    if qt.ready_at:
+                        self._placement_lat.record(
+                            time.perf_counter() - qt.ready_at)
                     spawn(
                         self._run_on_worker(qt, w)
                     )
@@ -2502,6 +2561,40 @@ class Raylet:
 
             jobs.append(self_prof())
         processes = list(await asyncio.gather(*jobs))
+        return {"node_id": self.node_id, "processes": processes}
+
+    # -- metrics plane (metrics_core.py) -------------------------------
+    async def rpc_metrics_snapshot(self, conn: Connection, p):
+        from ray_tpu._private import metrics_core
+
+        return metrics_core.process_snapshot(
+            "raylet", {"node_id": self.node_id})
+
+    async def rpc_metrics_node(self, conn: Connection, p):
+        """This raylet's snapshot plus every live worker's, gathered
+        CONCURRENTLY (one wedged worker must not stall the node scrape —
+        same posture as profile_node)."""
+        from ray_tpu._private import metrics_core
+
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+
+        async def one(w: _Worker):
+            try:
+                out = await w.conn.request(
+                    "metrics_snapshot", {},
+                    timeout=cfg.metrics_scrape_timeout_s)
+            except Exception as e:
+                return {"pid": w.proc.pid, "node_id": self.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+            out.setdefault("node_id", self.node_id)
+            return out
+
+        processes = list(await asyncio.gather(*[one(w) for w in live]))
+        processes.append(metrics_core.process_snapshot(
+            "raylet", {"node_id": self.node_id}))
         return {"node_id": self.node_id, "processes": processes}
 
     # ------------------------------------------------------------------

@@ -302,6 +302,81 @@ def _backoff_delay(attempt: int, base: float, cap: float) -> float:
     return delay * (0.5 + 0.5 * random.random())
 
 
+# --- runtime metrics (metrics_core.py) ---------------------------------
+# Built lazily so importing rpcio stays side-effect free; per-method
+# histogram/counter children are cached in plain dicts (the label lookup
+# must not cost a lock + tuple sort on the send hot path).
+class _RpcMetrics:
+    __slots__ = ("latency", "handled", "timeouts", "retries", "bytes_out",
+                 "bytes_in", "keepalive_deaths", "crc_errors",
+                 "_lat", "_handled", "_timeouts", "_retries")
+
+    def __init__(self):
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        self.latency = reg.histogram(
+            "rpc_request_latency_seconds",
+            "RPC request latency per verb, one record per ATTEMPT "
+            "(a retried call records each attempt)", scale=mc.LATENCY)
+        self.handled = reg.counter(
+            "rpc_handled_total",
+            "Requests whose handler actually EXECUTED here (idempotent "
+            "replays of a deduped retry are not re-counted)")
+        self.timeouts = reg.counter(
+            "rpc_request_timeouts_total", "Requests that hit their deadline")
+        self.retries = reg.counter(
+            "rpc_retries_total", "call_with_retries re-attempts")
+        self.bytes_out = reg.counter(
+            "rpc_bytes_sent_total", "Frame bytes written to peers").default
+        self.bytes_in = reg.counter(
+            "rpc_bytes_received_total", "Frame bytes read from peers").default
+        self.keepalive_deaths = reg.counter(
+            "rpc_keepalive_deaths_total",
+            "Connections reset after keepalive silence").default
+        self.crc_errors = reg.counter(
+            "rpc_frame_crc_errors_total",
+            "Inbound frames failing the v3 CRC32 head check").default
+        self._lat: Dict[str, Any] = {}
+        self._handled: Dict[str, Any] = {}
+        self._timeouts: Dict[str, Any] = {}
+        self._retries: Dict[str, Any] = {}
+
+    def lat(self, method: str):
+        c = self._lat.get(method)
+        if c is None:
+            c = self._lat[method] = self.latency.labels(method=method)
+        return c
+
+    def handled_c(self, method: str):
+        c = self._handled.get(method)
+        if c is None:
+            c = self._handled[method] = self.handled.labels(method=method)
+        return c
+
+    def timeout_c(self, method: str):
+        c = self._timeouts.get(method)
+        if c is None:
+            c = self._timeouts[method] = self.timeouts.labels(method=method)
+        return c
+
+    def retry_c(self, method: str):
+        c = self._retries.get(method)
+        if c is None:
+            c = self._retries[method] = self.retries.labels(method=method)
+        return c
+
+
+_MX: Optional[_RpcMetrics] = None
+
+
+def _mx() -> _RpcMetrics:
+    global _MX
+    if _MX is None:
+        _MX = _RpcMetrics()
+    return _MX
+
+
 # --- fault-injection write-queue markers (see faultsim.py) -------------
 class _FaultMarker:
     __slots__ = ("seconds", "parts")
@@ -393,6 +468,7 @@ class Connection:
                     logger.warning(
                         "rpc keepalive timeout on %s (%.1fs idle > %.1fs); "
                         "declaring peer dead", self.name, idle, timeout)
+                    _mx().keepalive_deaths.inc()
                     await self._do_close(ConnectionLost(
                         f"keepalive timeout on {self.name}: peer silent "
                         f"for {idle:.1f}s"))
@@ -521,6 +597,7 @@ class Connection:
             fault = plan.on_send(method, self._fault_peer())
             if fault is not None:
                 kind, rule = fault
+                faultsim.record_injection(kind, method)
                 if kind == "partition":
                     return None
                 if kind == "dup":
@@ -565,7 +642,14 @@ class Connection:
         parts = self._encode_frame(msg_id, KIND_REQ, method, payload)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        fut.add_done_callback(lambda _f: self._pending.pop(msg_id, None))
+        t0 = time.perf_counter()
+        lat = _mx().lat(method)
+
+        def _done(_f):
+            self._pending.pop(msg_id, None)
+            lat.record(time.perf_counter() - t0)
+
+        fut.add_done_callback(_done)
         self._enqueue_faulted(method, parts)
         return fut
 
@@ -588,6 +672,7 @@ class Connection:
             # loop until drained: frames appended while we're suspended in
             # drain() ride THIS task — a sender that sees the task not done
             # won't start another, so leaving them would stall delivery
+            sent = 0
             while self._wbuf and not self._closed:
                 buf, self._wbuf = self._wbuf, []
                 run: list = []
@@ -619,6 +704,7 @@ class Connection:
                     # ride as separate memoryview parts, by reference)
                     for part in frame if isinstance(frame, tuple) \
                             else (frame,):
+                        sent += _nbytes(part)
                         if _nbytes(part) > _JOIN_MAX:
                             # big part (object chunk / tensor): joining
                             # would memcpy MBs — flush the small run in
@@ -634,6 +720,9 @@ class Connection:
                         run[0] if len(run) == 1 else b"".join(run)
                     )
                 await self.writer.drain()
+            if sent:
+                # one counter bump per flush batch, not per frame
+                _mx().bytes_out.inc(sent)
 
     async def request(self, method: str, payload=None, timeout: float = None,
                       idem=None) -> Any:
@@ -667,6 +756,7 @@ class Connection:
         if timeout:
             def _expire():
                 if not fut.done():
+                    _mx().timeout_c(method).inc()
                     fut.set_exception(RpcTimeoutError(
                         f"request {method!r} on {self.name} exceeded "
                         f"{timeout}s deadline"))
@@ -674,10 +764,15 @@ class Connection:
             # call_later beats wait_for here: no wrapper task per request
             # on the hot path, just one timer handle
             handle = loop.call_later(timeout, _expire)
+        t0 = time.perf_counter()
         try:
             await self._send(msg_id, KIND_REQ, method, payload)
             return await fut
         finally:
+            # per-ATTEMPT latency: a retried call records every attempt
+            # (including the failed ones) while the *_total counters count
+            # logical executions exactly once — see _dispatch's dedup path
+            _mx().lat(method).record(time.perf_counter() - t0)
             if handle is not None:
                 handle.cancel()
             self._pending.pop(msg_id, None)
@@ -698,6 +793,7 @@ class Connection:
                     raise RpcError(f"oversized message: {n}")
                 data = await self.reader.readexactly(n)
                 self._last_rx = time.monotonic()
+                _mx().bytes_in.inc(n + _HDR)
                 if self.version >= 3:
                     msg_id, kind, method, payload = _decode_v3(data)
                 elif self.version == 2:
@@ -753,6 +849,7 @@ class Connection:
             # offset is untrustworthy after a corrupt frame, so reset and
             # let deadlines/retries re-issue in-flight calls
             logger.warning("resetting %s: %s", self.name, e)
+            _mx().crc_errors.inc()
             error = e
         except Exception as e:
             logger.exception("rpc recv loop error on %s", self.name)
@@ -806,6 +903,10 @@ class Connection:
                 return
         release = None
         try:
+            # counted HERE — after the dedup replay path has returned — so
+            # a retried idempotent request counts one logical execution no
+            # matter how many attempts the client's latency histogram saw
+            _mx().handled_c(method).inc()
             result = fn(self, payload)
             if asyncio.iscoroutine(result):
                 result = await result
@@ -988,6 +1089,7 @@ async def connect(host: str, port: int, handler=None, name: str = "client",
         try:
             plan = faultsim.active_plan()
             if plan is not None and plan.on_connect(addr):
+                faultsim.record_injection("partition", "connect")
                 raise ConnectionRefusedError(
                     f"fault injection: partitioned from {addr}")
             reader, writer = await asyncio.open_connection(host, port)
@@ -1089,6 +1191,7 @@ async def call_with_retries(get_conn, method: str, payload=None, *,
     last = None
     for attempt in range(max(1, attempts)):
         if attempt:
+            _mx().retry_c(method).inc()
             await asyncio.sleep(_backoff_delay(attempt, base_delay, max_delay))
         try:
             conn = get_conn() if callable(get_conn) else get_conn

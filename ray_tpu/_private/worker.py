@@ -1533,6 +1533,13 @@ class CoreWorker:
             out["actor_class"] = ex.actor_spec.name
         return out
 
+    # -- metrics plane (metrics_core.py) -------------------------------
+    async def rpc_metrics_snapshot(self, conn: Connection, p):
+        from ray_tpu._private import metrics_core
+
+        return self._annotate_profile(metrics_core.process_snapshot(
+            "driver" if self.is_driver else "worker"))
+
     async def rpc_pubsub(self, conn: Connection, p):
         self._dispatch_pubsub(p["channel"], p["message"])
 

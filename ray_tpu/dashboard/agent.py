@@ -11,6 +11,8 @@ Routes:
   GET /api/v0/stacks  — local workers' thread dumps
   GET /api/v0/profile?kind=cpu|mem&duration=N — node-local profiling
       window (raylet + its workers; see _private/profiler.py)
+  GET /metrics        — node-local Prometheus scrape (raylet + workers,
+      merged; also at /api/v0/metrics, ?format=json for raw snapshots)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
 """
@@ -76,6 +78,28 @@ class Agent:
                                    timeout=duration + 45)
         return _json(reply)
 
+    async def metrics(self, request):
+        """Node-local Prometheus scrape: this raylet + its workers,
+        merged (the per-node analog of the head's /metrics — a stock
+        Prometheus scrape_config can target every node agent directly).
+        ?format=json returns the raw per-process snapshots."""
+        from aiohttp import web
+
+        from ray_tpu._private import metrics_core
+        from ray_tpu.dashboard.prometheus import render_metrics
+
+        conn = await self._raylet()
+        reply = await conn.request("metrics_node", {}, timeout=30)
+        processes = reply.get("processes") or []
+        if request.query.get("format") == "json":
+            return _json(reply)
+        merged = metrics_core.merge_snapshots(
+            [p.get("metrics") or {} for p in processes
+             if not p.get("error")])
+        text = render_metrics(metrics_core.snapshot_records(merged))
+        return web.Response(text=text, content_type="text/plain",
+                            charset="utf-8")
+
     async def logs(self, request):
         log_dir = os.path.join(self.session_dir, "logs")
         out = []
@@ -122,6 +146,8 @@ async def amain(args) -> None:
     app.router.add_get("/api/v0/node", agent.node)
     app.router.add_get("/api/v0/stacks", agent.stacks)
     app.router.add_get("/api/v0/profile", agent.profile)
+    app.router.add_get("/metrics", agent.metrics)
+    app.router.add_get("/api/v0/metrics", agent.metrics)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
     runner = web.AppRunner(app)

@@ -11,9 +11,18 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 _server = None
+
+# In-head metrics history ring: one compact summary of the merged cluster
+# scrape per metrics_history_interval_s tick, metrics_history_len deep
+# (~10 min at the defaults). The SPA Metrics tab draws its sparkline
+# time-series from this — the head is the one process with a stable
+# vantage point, so reloading the page doesn't lose the series.
+_metrics_history: deque = deque(maxlen=240)
 
 
 def _json_response(payload, status: int = 200):
@@ -90,25 +99,52 @@ def _build_app():
         )
         return _json_response(out)
 
-    @routes.get("/metrics")
-    async def prometheus_metrics(request):
-        """Prometheus text exposition: user metrics + cluster built-ins
-        (ray parity: the per-node metrics agent's scrape endpoint)."""
+    def _prom_text() -> str:
+        """Merged cluster scrape (runtime + user metrics via the GCS
+        fan-out) + synthesized cluster built-ins, as one exposition."""
+        from ray_tpu._private import metrics_core
         from ray_tpu.dashboard.prometheus import (
             cluster_builtin_metrics,
             render_metrics,
         )
         from ray_tpu.util import metrics as m
 
-        def build():
-            records = dict(m.list_metrics())
-            records.update(cluster_builtin_metrics())
-            return render_metrics(records)
+        merged = m.cluster_snapshot().get("merged", {})
+        records = metrics_core.snapshot_records(merged)
+        records.update(cluster_builtin_metrics())
+        return render_metrics(records)
 
-        text = await asyncio.get_running_loop().run_in_executor(None, build)
+    @routes.get("/metrics")
+    async def prometheus_metrics(request):
+        """Prometheus text exposition: runtime + user metrics from ONE
+        cluster-wide scrape, plus cluster built-ins (ray parity: the
+        per-node metrics agent's scrape endpoint, lifted cluster-wide)."""
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, _prom_text)
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
         )
+
+    @routes.get("/api/metrics")
+    async def api_metrics(request):
+        """The same scrape as /metrics; ?format=json returns the compact
+        summary (counters/gauges -> value, histograms -> p50/p95/p99)."""
+        if request.query.get("format") == "json":
+            from ray_tpu.util import metrics as m
+
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, m.metrics_summary)
+            return _json_response(out)
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, _prom_text)
+        return web.Response(text=text, content_type="text/plain",
+                            charset="utf-8")
+
+    @routes.get("/api/v0/metrics_history")
+    async def metrics_history(request):
+        """The in-head snapshot ring (see _metrics_history): a list of
+        {ts, metrics} summaries the SPA renders as sparklines."""
+        return _json_response(list(_metrics_history))
 
     @routes.get("/api/v0/stacks")
     async def stacks(request):
@@ -205,6 +241,7 @@ class _DashboardServer:
         self.port = port
         self._loop = None
         self._error: Optional[BaseException] = None
+        self._history_task = None
         self._started = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="dashboard-head", daemon=True
@@ -229,6 +266,8 @@ class _DashboardServer:
             await site.start()
             self.port = site._server.sockets[0].getsockname()[1]
             self._started.set()
+            self._history_task = asyncio.get_running_loop().create_task(
+                self._history_loop())
 
         try:
             self._loop.run_until_complete(serve())
@@ -238,9 +277,55 @@ class _DashboardServer:
             return
         self._loop.run_forever()
 
+    async def _history_loop(self):
+        """Periodically fold one merged cluster scrape into the in-head
+        ring (sparkline time-series source). Scrape failures (GCS
+        restarting, teardown races) skip the tick — the ring must only
+        ever hold real snapshots."""
+        global _metrics_history
+
+        from ray_tpu._private import metrics_core
+        from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+        # deque maxlen is fixed at construction: rebuild the ring to the
+        # configured depth (the route reads the module global each call)
+        keep = max(2, int(cfg.metrics_history_len))
+        _metrics_history = deque(maxlen=keep)
+
+        def scrape():
+            from ray_tpu.util import metrics as m
+
+            snap = m.cluster_snapshot()
+            return {
+                "ts": time.time(),
+                "processes": sum(
+                    1 for p in snap.get("processes", ())
+                    if not p.get("error")),
+                "metrics": metrics_core.summarize(snap.get("merged", {})),
+            }
+
+        loop = asyncio.get_running_loop()
+        while True:
+            # the master switch gates the recurring fan-out too — a
+            # disabled plane must not keep paying the cluster scrape
+            if cfg.metrics_enabled:
+                try:
+                    entry = await loop.run_in_executor(None, scrape)
+                    _metrics_history.append(entry)
+                except Exception:
+                    pass
+            await asyncio.sleep(cfg.metrics_history_interval_s)
+
+    def _shutdown(self):
+        # runs ON the loop: cancel the history task first so it unwinds
+        # (its wakeup is queued ahead of the stop callback), then stop
+        if self._history_task is not None:
+            self._history_task.cancel()
+        self._loop.call_soon(self._loop.stop)
+
     def stop(self):
         if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop.call_soon_threadsafe(self._shutdown)
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:

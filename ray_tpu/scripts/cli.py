@@ -255,6 +255,47 @@ def cmd_profile(args):
         ray_tpu.shutdown()
 
 
+def cmd_metrics(args):
+    """One merged cluster-wide scrape (runtime + user metrics via the
+    GCS fan-out). Default output is Prometheus text exposition — pipe it
+    anywhere a scrape would go; --summary prints the human table with
+    p50/p95/p99 per histogram."""
+    import ray_tpu
+    from ray_tpu._private import metrics_core
+    from ray_tpu.util import metrics as m
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    try:
+        snap = m.cluster_snapshot()
+        merged = snap.get("merged", {})
+        if args.summary:
+            summary = metrics_core.summarize(merged)
+            name_w = max((len(n) for n in summary), default=10)
+            for name, entry in summary.items():
+                for s in entry["series"]:
+                    tags = ",".join(f"{k}={v}"
+                                    for k, v in sorted(s["tags"].items()))
+                    label = f"{name}{{{tags}}}" if tags else name
+                    if entry["type"] == "histogram":
+                        print(f"{label:<{name_w}s}  n={s['count']:<9d} "
+                              f"mean={s['mean']:.6f} p50={s['p50']:.6f} "
+                              f"p95={s['p95']:.6f} p99={s['p99']:.6f}")
+                    else:
+                        print(f"{label:<{name_w}s}  {s['value']:.6g}")
+        else:
+            text = m.prometheus_text(merged)
+            if args.output:
+                with open(args.output, "w") as f:
+                    f.write(text)
+                print(f"metrics -> {args.output}")
+            else:
+                print(text, end="")
+        for err in snap.get("errors", ()):
+            print(f"! unreachable: {err}", file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_events(args):
     import ray_tpu
     from ray_tpu.util import events as ev
@@ -520,6 +561,17 @@ def main(argv=None):
                    help="stacks/sites to print (default 10)")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "metrics",
+        help="merged cluster metrics scrape (Prometheus text / summary)",
+    )
+    p.add_argument("--summary", action="store_true",
+                   help="human table with p50/p95/p99 instead of "
+                        "Prometheus text")
+    p.add_argument("-o", "--output", help="write Prometheus text here")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("events", help="show structured cluster events")
     p.add_argument("--address")

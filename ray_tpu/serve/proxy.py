@@ -16,11 +16,48 @@ import asyncio
 import json
 import logging
 import threading
+import time as _time
 from typing import Dict, Optional, Tuple
 
 from ray_tpu.serve._common import ROUTES_PUSH_CHANNEL, Request
 
 logger = logging.getLogger(__name__)
+
+
+class _ProxyMetrics:
+    """Per-proxy request metrics (metrics_core.py): latency histogram per
+    app + an in-flight gauge the autoscaling ROADMAP item will read."""
+
+    __slots__ = ("latency", "inflight", "_lat")
+
+    def __init__(self):
+        from ray_tpu._private import metrics_core as mc
+
+        reg = mc.registry()
+        self.latency = reg.histogram(
+            "serve_request_latency_seconds",
+            "HTTP proxy end-to-end request latency, by app",
+            scale=mc.LATENCY)
+        self.inflight = reg.gauge(
+            "serve_inflight_requests",
+            "Requests currently inside this proxy").default
+        self._lat: Dict[str, object] = {}
+
+    def lat(self, app: str):
+        c = self._lat.get(app)
+        if c is None:
+            c = self._lat[app] = self.latency.labels(app=app)
+        return c
+
+
+_PROXY_MX: Optional[_ProxyMetrics] = None
+
+
+def _proxy_metrics() -> _ProxyMetrics:
+    global _PROXY_MX
+    if _PROXY_MX is None:
+        _PROXY_MX = _ProxyMetrics()
+    return _PROXY_MX
 
 # with push in place the poll is only a safety net
 _ROUTE_POLL_TTL_S = 10.0
@@ -430,6 +467,18 @@ class HTTPProxy:
         return best
 
     async def _handle(self, request):
+        mx = _proxy_metrics()
+        mx.inflight.inc()
+        t0 = _time.perf_counter()
+        app_name = "?"
+        try:
+            resp, app_name = await self._handle_inner(request)
+            return resp
+        finally:
+            mx.inflight.dec()
+            mx.lat(app_name).record(_time.perf_counter() - t0)
+
+    async def _handle_inner(self, request):
         from aiohttp import web
 
         from ray_tpu.serve.replica import STREAM_MARKER
@@ -441,7 +490,7 @@ class HTTPProxy:
             await self._refresh_routes(force=True)
             m = self._match(request.path)
         if m is None:
-            return web.Response(status=404, text="no app at this route")
+            return web.Response(status=404, text="no app at this route"), "?"
         _prefix, (app_name, ingress) = m
         body = await request.read()
         env = Request(
@@ -485,9 +534,11 @@ class HTTPProxy:
         try:
             result = await loop.run_in_executor(self._pool, call)
         except Exception as e:  # noqa: BLE001 — surface as 500
-            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            return web.Response(status=500,
+                                text=f"{type(e).__name__}: {e}"), app_name
         if isinstance(result, dict) and STREAM_MARKER in result:
-            return await self._stream_response(request, result[STREAM_MARKER])
+            return await self._stream_response(
+                request, result[STREAM_MARKER]), app_name
         from ray_tpu.serve._common import Response as ServeResponse
 
         if isinstance(result, ServeResponse):
@@ -501,12 +552,13 @@ class HTTPProxy:
                 if k.lower() not in ("content-length", "transfer-encoding")
             )
             return web.Response(status=result.status, headers=headers,
-                                body=result.body)
+                                body=result.body), app_name
         if isinstance(result, bytes):
-            return web.Response(body=result)
+            return web.Response(body=result), app_name
         if isinstance(result, str):
-            return web.Response(text=result)
-        return web.json_response(result, dumps=lambda o: json.dumps(o, default=str))
+            return web.Response(text=result), app_name
+        return web.json_response(
+            result, dumps=lambda o: json.dumps(o, default=str)), app_name
 
     async def _stream_response(self, request, info):
         """Chunked transfer of a generator deployment's output: each chunk

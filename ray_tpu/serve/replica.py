@@ -73,6 +73,30 @@ class Replica:
             self._is_function = True
         if user_config is not None:
             self.reconfigure(user_config)
+        self._setup_metrics()
+
+    def _setup_metrics(self):
+        """Replica-side metrics (metrics_core.py): request latency per
+        deployment + an ongoing-requests gauge (the queue-depth signal
+        autoscaling reads). The replica runs in its own worker process,
+        so the cluster scrape reaches these through its raylet."""
+        try:
+            from ray_tpu._private import metrics_core as mc
+
+            reg = mc.registry()
+            tags = {"app": self._app, "deployment": self._deployment}
+            self._m_latency = reg.histogram(
+                "serve_replica_request_seconds",
+                "Replica request handling latency, by deployment",
+                scale=mc.LATENCY).labels(**tags)
+            reg.gauge("serve_replica_ongoing_requests",
+                      "Requests in flight inside the replica"
+                      ).labels(**tags).set_fn(lambda: self._ongoing)
+            reg.gauge("serve_replica_total_requests",
+                      "Requests handled by the replica (monotonic)"
+                      ).labels(**tags).set_fn(lambda: self._total)
+        except Exception:
+            self._m_latency = None
 
     # -- control plane --------------------------------------------------
     def reconfigure(self, user_config: Any):
@@ -110,6 +134,7 @@ class Replica:
         self._reap_stale_streams()
         self._ongoing += 1
         self._total += 1
+        t0 = time.perf_counter()
         try:
             target = self._target(method_name)
             unbound = target if self._is_function or method_name not in (
@@ -135,6 +160,8 @@ class Replica:
             return out
         finally:
             self._ongoing -= 1
+            if self._m_latency is not None:
+                self._m_latency.record(time.perf_counter() - t0)
 
     # -- streaming ------------------------------------------------------
     def _start_stream(self, target, unbound, args, kwargs) -> dict:

@@ -30,6 +30,7 @@ __all__ = [
     "timeline",
     "profile_cpu",
     "profile_memory",
+    "metrics_summary",
 ]
 
 
@@ -185,6 +186,15 @@ def profile_memory(**kwargs):
     from ray_tpu.util import profiling
 
     return profiling.profile_memory(**kwargs)
+
+
+def metrics_summary() -> dict:
+    """Merged cluster-wide runtime+user metrics, compacted: counters and
+    gauges -> value per labelset, histograms -> count/sum/mean/p50/p95/p99
+    (one GCS fan-out scrape; see ray_tpu.util.metrics)."""
+    from ray_tpu.util import metrics
+
+    return metrics.metrics_summary()
 
 
 def summarize_tasks() -> dict:

@@ -13,11 +13,32 @@
 # Replaying a chaos failure: every armed fault plan is logged at WARNING
 # ("faultsim armed ...") with its full spec, including each rule's seed.
 # Re-export the logged spec verbatim (RAY_TPU_RPC_FAULTS=...) to replay
-# the same decision sequence.
-set -euo pipefail
+# the same decision sequence. Injections are also metered
+# (rpc_faults_injected_total{kind=...}) and — with RAY_TPU_TRACING=1 —
+# traced, so the failure dump below correlates failures with the exact
+# faults injected.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
 TIMEOUT="${CHAOS_TIMEOUT:-1800}"
-exec timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m chaos -p no:cacheprovider \
     -p no:xdist -p no:randomly "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    # Failure triage: dump a cluster-wide metrics snapshot from whatever
+    # cluster is still reachable (a long-lived `ray_tpu start` cluster, or
+    # one a wedged test left behind) so fault-injection counters and tail
+    # latencies land next to the failing output. Best effort: most chaos
+    # tests tear their clusters down with them.
+    out="${CHAOS_METRICS_DUMP:-/tmp/chaos_metrics_dump.prom}"
+    echo "chaos lane failed (rc=$rc); dumping cluster metrics snapshot" >&2
+    if timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu metrics -o "$out" >/dev/null 2>&1; then
+        echo "cluster metrics snapshot -> $out" >&2
+        grep -a 'rpc_faults_injected_total' "$out" >&2 || true
+    else
+        echo "(no live cluster to scrape)" >&2
+    fi
+fi
+exit "$rc"

@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers",
         "chaos: kill/partition/fault-injection chaos test "
         "(run the heavy ones via scripts/run_chaos.sh)")
+    config.addinivalue_line(
+        "markers",
+        "metrics: metrics-plane test (metrics_core, scrape fan-out, "
+        "overhead gate)")
 
 
 def wait_for_condition(condition, timeout: float = 30.0,

@@ -156,7 +156,8 @@ def test_dashboard_spa_and_full_api_surface(ray_start_regular):
         # every endpoint the SPA's want-map fetches must answer
         for ep in ("nodes", "actors", "tasks?limit=1000", "objects?limit=500",
                    "placement_groups", "jobs", "events?limit=200", "metrics",
-                   "timeline", "tasks/summarize", "cluster_resources"):
+                   "metrics_history", "timeline", "tasks/summarize",
+                   "cluster_resources"):
             out = _get(port, f"/api/v0/{ep}")
             assert out is not None, ep
         nodes = _get(port, "/api/v0/nodes")
