@@ -205,6 +205,158 @@ def _metrics_overhead_main():
     os._exit(0)
 
 
+def _log_line_costs():
+    """Calibrate the per-line cost of the streaming pipeline's two hot
+    stages, UNCONTENDED (same discipline as the metrics lane's
+    measure_record_cost x event count: this box virtualizes thread CPU
+    clocks in 10ms quanta, so in-situ self-timing of sub-ms slices reads
+    zero — calibrated-cost x line-count is the robust estimator):
+    (a) raylet tail+attribute+decode, (b) driver dedup+render."""
+    import tempfile
+
+    from ray_tpu._private import logplane
+    from ray_tpu._private.raylet import _tail_worker_log
+
+    n = 20_000
+
+    class _P:
+        pid = 1
+
+    class _W:
+        proc = _P()
+        job_id = None
+        log_offset = 0
+        log_partial = b""
+        log_spans = logplane.SpanTable()
+        log_name = "cal"
+
+    w = _W()
+    with tempfile.NamedTemporaryFile(suffix=".out", delete=False) as f:
+        f.write(b"\n".join(b"calibration line %06d x" % i
+                           for i in range(n)) + b"\n")
+        w.log_path = f.name
+    try:
+        t0 = time.perf_counter()
+        _entry, stats = _tail_worker_log(w, final=True)
+        tail_cost = (time.perf_counter() - t0) / max(1, stats["lines"])
+    finally:
+        os.unlink(w.log_path)
+
+    dedup = logplane.LogDeduplicator(window_s=1.0)
+    lines = [f"cal-line-{i}" for i in range(n)]
+    t0 = time.perf_counter()
+    out = []
+    for ln in lines:
+        out.extend(dedup.feed("\x1b[36m(cal pid=1 node=ab)\x1b[0m ", ln))
+    "\n".join(out)
+    handler_cost = (time.perf_counter() - t0) / n
+    return tail_cost, handler_cost
+
+
+def _log_overhead_main():
+    """BENCH_LOG_OVERHEAD=1: the log plane's acceptance numbers on a
+    print-heavy sync-task loop. (a) streaming share: lines published
+    during the window x calibrated per-line pipeline cost (raylet
+    tail+attribute + driver dedup+render), divided by window wall time —
+    gated <2%. (b) off posture: with log_to_driver=False the driver
+    never subscribes, raylets see zero "logs" subscribers via the
+    heartbeat and skip tailing entirely — gated ZERO lines published.
+    Throughput A/B is reported, not gated (this box's A/A noise ~1.8x).
+    Emits ONE JSON line, same contract as the default bench path."""
+    import ray_tpu
+    from ray_tpu._private import metrics_core
+
+    def counter_total(merged, name):
+        entry = metrics_core.summarize(merged).get(name)
+        if not entry:
+            return 0.0
+        return sum(s.get("value", 0.0) for s in entry["series"])
+
+    def scrape():
+        from ray_tpu.util import metrics as m
+
+        return m.cluster_snapshot().get("merged", {})
+
+    tail_cost, handler_cost = _log_line_costs()
+
+    def run_window(batch=100, repeat=3):
+        @ray_tpu.remote
+        def _chatty(i, r):
+            for k in range(5):  # unique lines: dedup must not hide work
+                print(f"log-overhead {r}-{i}-{k}")
+            return i
+
+        best = 0.0
+        for r in range(repeat):
+            t0 = time.perf_counter()
+            ray_tpu.get([_chatty.remote(i, r) for i in range(batch)])
+            best = max(best, batch / (time.perf_counter() - t0))
+        return best
+
+    # phase 1: streaming ON (driver subscribed by default)
+    ray_tpu.init(num_cpus=2)
+    try:
+        run_window(batch=40, repeat=1)  # warm pools/leases
+        time.sleep(1.0)                 # let the tailer drain the warmup
+        before = scrape()
+        t0 = time.perf_counter()
+        on_tput = run_window()
+        time.sleep(1.0)  # last tail tick + pubsub delivery land
+        window_s = time.perf_counter() - t0
+        after = scrape()
+        d = {
+            name: counter_total(after, name) - counter_total(before, name)
+            for name in ("raylet_log_tail_cpu_seconds_total",
+                         "driver_log_handler_seconds_total",
+                         "raylet_log_lines_published_total")
+        }
+        on_lines = d["raylet_log_lines_published_total"]
+        stream_fraction = on_lines * (tail_cost + handler_cost) / window_s
+    finally:
+        ray_tpu.shutdown()
+
+    # phase 2: log_to_driver=False — no subscriber, raylets skip tailing
+    ray_tpu.init(num_cpus=2, log_to_driver=False)
+    try:
+        run_window(batch=40, repeat=1)
+        time.sleep(1.5)  # past the first heartbeat: subscriber count known
+        before = scrape()
+        off_tput = run_window()
+        time.sleep(1.0)
+        after = scrape()
+        off_lines = (counter_total(after, "raylet_log_lines_published_total")
+                     - counter_total(before,
+                                     "raylet_log_lines_published_total"))
+        off_tail_cpu = (
+            counter_total(after, "raylet_log_tail_cpu_seconds_total")
+            - counter_total(before, "raylet_log_tail_cpu_seconds_total"))
+    finally:
+        ray_tpu.shutdown()
+
+    ok = stream_fraction < 0.02 and on_lines > 0 and off_lines == 0
+    print(json.dumps({
+        "metric": "log_overhead_stream_fraction",
+        "value": round(stream_fraction, 5),
+        "unit": "fraction",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "stream_fraction": stream_fraction,
+            "per_line_tail_cost_us": round(tail_cost * 1e6, 2),
+            "per_line_handler_cost_us": round(handler_cost * 1e6, 2),
+            "lines_published_on": on_lines,
+            "lines_published_off": off_lines,
+            "self_timed_cpu_seconds_on": round(
+                d["raylet_log_tail_cpu_seconds_total"]
+                + d["driver_log_handler_seconds_total"], 4),
+            "tail_cpu_seconds_off": off_tail_cpu,
+            "tput_on": on_tput,
+            "tput_off": off_tput,
+            "tput_ratio_on_over_off": on_tput / off_tput if off_tput else None,
+        },
+    }), flush=True)
+    os._exit(0)
+
+
 def main():
     signal.signal(signal.SIGTERM, _emit_and_exit)
     threading.Thread(target=_watchdog_thread, daemon=True).start()
@@ -213,6 +365,8 @@ def main():
         _profiler_overhead_main()
     if os.environ.get("BENCH_METRICS_OVERHEAD"):
         _metrics_overhead_main()
+    if os.environ.get("BENCH_LOG_OVERHEAD"):
+        _log_overhead_main()
 
     on_tpu = _tpu_reachable()
 

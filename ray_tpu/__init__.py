@@ -50,12 +50,13 @@ from ray_tpu.api import (
 from ray_tpu.runtime_context import get_runtime_context
 
 
-def timeline(filename=None):
-    """Chrome-trace dump of cluster task events (ray parity: ray.timeline,
-    _private/state.py:416 chrome_tracing_dump)."""
+def timeline(filename=None, limit=None):
+    """Chrome-trace dump of cluster task events + tracing spans (ray
+    parity: ray.timeline, _private/state.py:416 chrome_tracing_dump).
+    ``limit`` caps the raw events fetched from the GCS."""
     from ray_tpu.util.state import timeline as _timeline
 
-    return _timeline(filename)
+    return _timeline(filename, limit=limit)
 
 
 __version__ = "0.1.0"

@@ -141,8 +141,25 @@ _flag("metrics_scrape_timeout_s", float, 10.0)
 _flag("metrics_report_interval_s", float, 2.0)
 _flag("task_events_buffer_size", int, 10_000)
 _flag("event_stats", bool, True)
-# Worker-log streaming to drivers (ray: log_monitor.py tail cadence)
+# Worker-log streaming to drivers (ray: log_monitor.py tail cadence +
+# worker.py print_logs). log_to_driver is the master gate for the driver
+# subscription (RAY_TPU_LOG_TO_DRIVER=0 kills it cluster-wide); raylets
+# additionally skip tailing entirely while the GCS reports zero "logs"
+# subscribers, so an unwatched cluster pays nothing for the log plane.
 _flag("log_tail_interval_s", float, 0.3)
+_flag("log_to_driver", bool, True)
+# driver-side dedup: identical lines fanning in from many workers within
+# this window collapse to one line + "[repeated Nx]" summary
+_flag("log_dedup_window_s", float, 1.0)
+# length caps on published records: lines longer than this are truncated
+# (counted in raylet_log_lines_truncated_total), and one publish batch
+# never carries more than log_publish_max_bytes of line payload per tick
+# (excess lines defer to the next tick via the tail offset)
+_flag("log_max_line_bytes", int, 4096)
+_flag("log_publish_max_bytes", int, 2 * 1024 * 1024)
+# closed per-task byte-range attribution spans kept per worker for the
+# tailer's line -> task-name resolution (bounded ring)
+_flag("log_span_history", int, 128)
 # Push plane (ray: push_manager.h max_chunks_in_flight per push)
 _flag("push_max_chunks_in_flight", int, 8)
 _flag("push_rx_expiry_s", float, 60.0)  # abandoned inbound push sessions

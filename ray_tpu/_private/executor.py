@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from ray_tpu._private import object_store, profiler, serialization
+from ray_tpu._private import logplane, object_store, profiler, serialization
 from ray_tpu._private.common import TaskSpec
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -253,6 +253,11 @@ class TaskExecutor:
         if gated:
             await self._await_turn(specs[0].caller_id, specs[0].seq_no)
         done_q: asyncio.Queue = asyncio.Queue()
+        # per-item (start, end) log offsets, written by the pool thread in
+        # each item's finally BEFORE its done_q put (happens-before via
+        # call_soon_threadsafe), read when packaging that item's result
+        log_spans: list = [None] * len(specs)
+        log_file = logplane.worker_log_path()
         delivered = 0
         try:
             resolved = []
@@ -290,12 +295,17 @@ class TaskExecutor:
                     args, kwargs = r[1]
                     self.current_task_id = spec.task_id
                     t_start = time.perf_counter()
+                    # log attribution: exact byte range of this item's
+                    # stdout/stderr in the worker log (stdio flushed on
+                    # both edges, so batch neighbors never bleed)
+                    log_start = logplane.stdio_offset()
                     try:
                         with profiler.tag_current_thread.for_spec(spec):
                             out = (idx, True, call(*args, **kwargs))
                     except Exception as e:
                         out = (idx, False, e)
                     finally:
+                        log_spans[idx] = (log_start, logplane.stdio_offset())
                         self.current_task_id = None
                         # wait = batch arrival at the executor to THIS
                         # item's user-code start (seq gate + arg resolve
@@ -319,6 +329,13 @@ class TaskExecutor:
                         serialization.serialize_error(value, spec.name),
                         app_error=True,
                     )
+                span = log_spans[idx]
+                if (log_file and span and span[0] is not None
+                        and span[1] is not None):
+                    result["log_span"] = {
+                        "file": os.path.basename(log_file),
+                        "start": span[0], "end": max(span[1], span[0]),
+                    }
                 if gated:
                     await self._advance_turn(spec.caller_id)
                 delivered += 1
@@ -413,6 +430,9 @@ class TaskExecutor:
             sv = serialization.serialize_error(e, spec.name)
             return self._error_result(sv, app_error=False)
         t_run = time.perf_counter()
+        # log attribution: byte range of this task's output in the worker
+        # log (exact; stamped onto the result for the task-event pipeline)
+        log_start = logplane.stdio_offset()
         try:
             ctx = getattr(spec, "tracing_ctx", None)
             if is_actor_task:
@@ -447,7 +467,8 @@ class TaskExecutor:
                     )
         except Exception as e:
             sv = serialization.serialize_error(e, spec.name)
-            return self._error_result(sv, app_error=True)
+            return logplane.attach_result_span(
+                self._error_result(sv, app_error=True), log_start)
         finally:
             self.current_task_id = None
             _exec_metrics().record(
@@ -455,7 +476,8 @@ class TaskExecutor:
                 (t_run - t_in) if t_in is not None else 0.0,
                 time.perf_counter() - t_run,
             )
-        return self._package_returns(spec, value, start)
+        return logplane.attach_result_span(
+            self._package_returns(spec, value, start), log_start)
 
     def _load_fn(self, func_blob: bytes):
         """Deserialize a task function with a digest-keyed cache: a driver

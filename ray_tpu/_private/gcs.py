@@ -292,6 +292,13 @@ class GcsServer:
     # Connection lifecycle
     # ------------------------------------------------------------------
     def on_disconnect(self, conn: Connection):
+        # drop pubsub subscriptions FIRST: the driver/raylet early
+        # returns below used to skip this, leaving dead conns inflating
+        # subscriber counts (the heartbeat-reported "logs" count gates
+        # raylet log tailing, so a leak here would keep every raylet
+        # tailing after the last driver exited)
+        for subs in self.subscribers.values():
+            subs.discard(conn)
         kind = conn.meta.get("kind")
         if kind == "raylet":
             node_id = conn.meta["node_id"]
@@ -302,8 +309,6 @@ class GcsServer:
             job_id = conn.meta.get("job_id")
             if conn.meta.get("is_driver") and job_id is not None:
                 return self._on_driver_exit(job_id)
-        for subs in self.subscribers.values():
-            subs.discard(conn)
 
     async def _on_driver_exit(self, job_id: bytes):
         """Driver died/finished: finish job, destroy its non-detached actors."""
@@ -391,7 +396,10 @@ class GcsServer:
         node.idle = idle
         if not node.alive:
             node.alive = True
-        return {}
+        # "logs"-channel subscriber count: raylets skip tailing worker
+        # logs entirely while nobody is listening (log plane costs
+        # nothing on an unwatched cluster)
+        return {"log_subscribers": len(self.subscribers.get("logs", ()))}
 
     async def rpc_get_load_metrics(self, conn: Connection, _):
         """Autoscaler input: per-node demand + idle durations (ray:

@@ -71,7 +71,8 @@ async def amain(args):
     service = KvService(args.path)
     server = RpcServer(service, host=args.host, port=args.port)
     port = await server.start()
-    print(f"kv server listening on {args.host}:{port}", flush=True)
+    # stdout protocol: the spawning parent reads this line for the port
+    print(f"kv server listening on {args.host}:{port}", flush=True)  # lint: allow-print
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:

@@ -81,7 +81,8 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
                     f"p95={row['p95_us']:,.0f}us "
                     f"p99={row['p99_us']:,.0f}us")
         results.append(row)
-        print(f"{name:<42s} {ops_s:>12,.1f} {unit}{tail}")
+        # CLI table output (ray_tpu microbenchmark prints to stdout)
+        print(f"{name:<42s} {ops_s:>12,.1f} {unit}{tail}")  # lint: allow-print
 
     benches: Dict[str, Tuple[str, Callable[[], Tuple[str, float]]]] = {}
 
@@ -276,6 +277,6 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         record(name, value, "GB/s" if key == "put_gigabytes" else "ops/s",
                lat=lat)
     if not results:
-        print(f"no benchmarks matched --select {select!r}; available: "
+        print(f"no benchmarks matched --select {select!r}; available: "  # lint: allow-print
               + ", ".join(benches))
     return results

@@ -44,7 +44,7 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private import faultsim
+from ray_tpu._private import faultsim, logplane
 from ray_tpu._private.rpcio import (Connection, Finalized, RpcError,
                                     RpcServer, call_with_retries, connect,
                                     spawn)
@@ -96,6 +96,12 @@ class _Worker:
         self.log_path = log_path
         self.log_offset = 0
         self.log_partial = b""
+        # byte-range -> task-name attribution for streamed lines, fed
+        # from the task events flowing through this raylet (logplane.py)
+        self.log_spans = logplane.SpanTable(cfg.log_span_history)
+        # fallback prefix name for lines outside any task span (set to
+        # the actor class once this worker becomes an actor)
+        self.log_name: Optional[str] = None
 
     def kill_process(self):
         """Kill the worker AND its container, if any: a plain kill only
@@ -118,6 +124,80 @@ class _Worker:
                     )
             except OSError:
                 pass
+
+
+def _tail_worker_log(w: _Worker, final: bool = False):
+    """Read newly appended bytes of one worker's log and attribute each
+    line to its task by byte offset (w.log_spans). Returns
+    ``(entry, stats)`` — entry is a batch record ``{pid, job_id, segs}``
+    with ``segs`` = consecutive-line groups ``[task_name_or_None,
+    [lines...]]``, or None when nothing new. ``final`` drains to EOF and
+    flushes the partial line (worker exiting — its last write IS the
+    traceback). Chunk bytes are split into fresh ``bytes`` objects before
+    anything retains them: relay paths must never hold exported
+    memoryviews of reused buffers (see the documented GC tp_clear
+    hazard)."""
+    stats = {"lines": 0, "bytes": 0, "truncated": 0}
+    if not w.log_path:
+        return None, stats
+    lines_out = []  # (absolute_start_offset, raw_line)
+    pos = w.log_offset - len(w.log_partial)
+    budget = cfg.log_publish_max_bytes  # per-tick cap: keeps a chatty
+    # worker from monopolizing the tick without letting it lag unboundedly
+    try:
+        with open(w.log_path, "rb") as f:
+            f.seek(w.log_offset)
+            while True:
+                chunk = f.read(65536)
+                if not chunk:
+                    break
+                w.log_offset += len(chunk)
+                data = w.log_partial + chunk
+                *lines, w.log_partial = data.split(b"\n")
+                for ln in lines:
+                    lines_out.append((pos, ln))
+                    pos += len(ln) + 1
+                budget -= len(chunk)
+                if not final and budget <= 0:
+                    break  # bounded per tick; the next tick continues
+    except OSError:
+        return None, stats
+    if final and w.log_partial:
+        lines_out.append((pos, w.log_partial))
+        w.log_partial = b""
+    segs: list = []  # [[task_name_or_None, [text...]], ...]
+    for off, raw in lines_out:
+        if not raw:
+            continue
+        raw, truncated = logplane.truncate_line(raw, cfg.log_max_line_bytes)
+        stats["truncated"] += truncated
+        stats["lines"] += 1
+        stats["bytes"] += len(raw)
+        name = w.log_spans.resolve(off) or w.log_name
+        text = raw.decode("utf-8", "replace")
+        if segs and segs[-1][0] == name:
+            segs[-1][1].append(text)
+        else:
+            segs.append([name, [text]])
+    w.log_spans.prune(w.log_offset - len(w.log_partial))
+    if not segs:
+        return None, stats
+    return {
+        "pid": w.proc.pid,
+        "job_id": w.job_id.hex() if w.job_id else None,
+        "segs": segs,
+    }, stats
+
+
+def _feed_log_span(w: _Worker, ev: dict):
+    """Fold one task event's log fields into the worker's span table
+    (direct-push workers self-report events through rpc_task_events;
+    raylet-routed tasks stamp events in _run_on_worker)."""
+    if ev.get("log_end") is not None and ev.get("log_start") is not None:
+        w.log_spans.close_span(ev["task_id"], ev.get("name"),
+                               ev["log_start"], ev["log_end"])
+    elif ev.get("log_start") is not None:
+        w.log_spans.open_span(ev["task_id"], ev.get("name"), ev["log_start"])
 
 
 # Pull priorities (ray: pull_manager.h:31-38 BundlePriority — Get before
@@ -361,7 +441,14 @@ class Raylet:
         self._stopping = False
         self.port = None
         # metrics
-        self.counters = {"tasks_dispatched": 0, "tasks_spilled": 0, "objects_pulled": 0}
+        self.counters = {"tasks_dispatched": 0, "tasks_spilled": 0,
+                         "objects_pulled": 0, "log_lines_published": 0,
+                         "log_bytes_published": 0, "log_lines_truncated": 0}
+        # log plane: "logs"-channel subscriber count piggybacked on the
+        # heartbeat reply (-1 = unknown yet -> tail); tailer CPU seconds
+        # accumulate for the BENCH_LOG_OVERHEAD self-measured share
+        self._log_subscribers = -1
+        self._log_tail_cpu_s = 0.0
         self._setup_metrics()
         # Task state-transition buffer, flushed in batches to the GCS
         # (ray: src/ray/core_worker/task_event_buffer.h:199 — we buffer at
@@ -412,6 +499,24 @@ class Raylet:
         gauge("raylet_store_spilled_objects",
               "Objects currently spilled out of shm",
               lambda: self.store.spilled_stats()["spilled_objects"])
+        # log plane self-measurement (channel-tagged: the "logs" pubsub
+        # channel is the only one carrying log records today)
+        ltags = dict(tags, channel="logs")
+        reg.counter("raylet_log_lines_published_total",
+                    "Worker log lines published to the logs channel"
+                    ).labels(**ltags).set_fn(
+            lambda: self.counters["log_lines_published"])
+        reg.counter("raylet_log_bytes_published_total",
+                    "Worker log bytes published to the logs channel"
+                    ).labels(**ltags).set_fn(
+            lambda: self.counters["log_bytes_published"])
+        reg.counter("raylet_log_lines_truncated_total",
+                    "Log lines cut at log_max_line_bytes before publish"
+                    ).labels(**ltags).set_fn(
+            lambda: self.counters["log_lines_truncated"])
+        reg.counter("raylet_log_tail_cpu_seconds_total",
+                    "CPU seconds spent tailing+attributing worker logs"
+                    ).labels(**ltags).set_fn(lambda: self._log_tail_cpu_s)
         self._placement_lat = reg.histogram(
             "raylet_task_placement_latency_seconds",
             "Ready-queue entry to worker dispatch", scale=mc.LATENCY,
@@ -508,66 +613,71 @@ class Raylet:
 
     # ------------------------------------------------------------------
     # worker-log streaming (ray: _private/log_monitor.py — the per-node
-    # monitor tails worker log files and publishes lines so connected
-    # drivers can print them)
+    # monitor tails worker log files and publishes attributed lines on
+    # the GCS "logs" pubsub channel so subscribed drivers can print them)
     # ------------------------------------------------------------------
-    def _tail_worker_log(self, w: _Worker, final: bool = False):
-        """Read newly appended bytes of one worker's log; returns a batch
-        entry or None. ``final`` drains to EOF and flushes the partial
-        line (worker exiting — its last write IS the traceback)."""
-        if not w.log_path:
-            return None
-        lines_out = []
-        budget = 2 * 1024 * 1024  # per-tick cap: keeps a chatty worker from
-        # monopolizing the tick without letting it lag unboundedly behind
-        try:
-            with open(w.log_path, "rb") as f:
-                f.seek(w.log_offset)
-                while True:
-                    chunk = f.read(65536)
-                    if not chunk:
-                        break
-                    w.log_offset += len(chunk)
-                    data = w.log_partial + chunk
-                    *lines, w.log_partial = data.split(b"\n")
-                    lines_out.extend(lines)
-                    budget -= len(chunk)
-                    if not final and budget <= 0:
-                        break  # bounded per tick; the next tick continues
-        except OSError:
-            return None
-        if final and w.log_partial:
-            lines_out.append(w.log_partial)
-            w.log_partial = b""
-        text = [ln.decode("utf-8", "replace") for ln in lines_out if ln]
-        if not text:
-            return None
-        return {
-            "pid": w.proc.pid,
-            "job_id": w.job_id.hex() if w.job_id else None,
-            "lines": text,
-        }
-
     async def _publish_worker_logs(self, batch):
         if not batch:
             return
         try:
+            # rides the GCS's batched pubsub outbox (gcs._publish): a
+            # burst of per-worker entries costs one frame per subscriber
             await self.gcs.request("publish", {
-                "channel": "worker_log",
+                "channel": "logs",
                 "message": {"node_id": self.node_id, "workers": batch},
             })
         except Exception:
             pass
 
+    def _log_resume_bounded(self):
+        """A subscriber appeared after a zero-subscriber window in which
+        tailing was skipped entirely. Resume from where the tailer
+        stopped — NOT from EOF: the subscriber count is heartbeat-lagged
+        (up to heartbeat_interval_s stale), so a task that printed right
+        after the driver subscribed would have its lines silently
+        skipped by an EOF jump. Instead cap the backlog at one tick
+        budget; the driver's job filter drops foreign-job history
+        anyway."""
+        for w in self.all_workers.values():
+            if not w.log_path:
+                continue
+            try:
+                size = os.path.getsize(w.log_path)
+            except OSError:
+                continue
+            floor = max(0, size - cfg.log_publish_max_bytes)
+            if w.log_offset < floor:
+                w.log_offset = floor
+                w.log_partial = b""
+
     async def _log_tailer_loop(self):
         while True:
             await asyncio.sleep(cfg.log_tail_interval_s)
+            if self._log_subscribers == 0:
+                # nobody is listening (heartbeat-reported subscriber
+                # count): skip even the file reads — an unwatched
+                # cluster pays nothing for the log plane
+                continue
+            # thread_time, not perf_counter: the counter advertises CPU
+            # seconds, and on a busy raylet wall time inside this loop is
+            # mostly GIL/scheduler waits — it would overstate the share
+            # the BENCH_LOG_OVERHEAD lane gates by several x
+            t0 = time.thread_time()
             batch = []
             for w in list(self.all_workers.values()):
-                entry = self._tail_worker_log(w)
+                entry, stats = _tail_worker_log(w)
+                self._log_account(stats)
                 if entry:
                     batch.append(entry)
+            self._log_tail_cpu_s += time.thread_time() - t0
             await self._publish_worker_logs(batch)
+
+    def _log_account(self, stats):
+        if stats is None:
+            return
+        self.counters["log_lines_published"] += stats["lines"]
+        self.counters["log_bytes_published"] += stats["bytes"]
+        self.counters["log_lines_truncated"] += stats["truncated"]
 
     # ------------------------------------------------------------------
     # task events (observability; ray: task_event_buffer.h:199)
@@ -815,6 +925,11 @@ class Raylet:
                         timeout=cfg.gcs_rpc_timeout_s,
                     )
                     self._on_view(reply["nodes"])
+                subs = reply.get("log_subscribers")
+                if subs is not None:
+                    if self._log_subscribers == 0 and subs > 0:
+                        self._log_resume_bounded()
+                    self._log_subscribers = subs
             except (RpcError, OSError):
                 # transient (RpcError covers ConnectionLost/RpcTimeoutError):
                 # the reconnect loop (on_disconnect) owns recovery; the next
@@ -983,8 +1098,15 @@ class Raylet:
         while len(self._worker_fates) > 256:
             self._worker_fates.pop(next(iter(self._worker_fates)))
         # final log drain: the crash traceback lands in the file right as
-        # the process exits, after the tailer's last tick — deliver it
-        entry = self._tail_worker_log(w, final=True)
+        # the process exits, after the tailer's last tick — deliver it.
+        # Skipped entirely at zero subscribers: the tailer has been
+        # skipping too, so this read would synchronously chew through the
+        # whole untailed backlog on the event loop just to discard it
+        # (and count never-published lines in the published counters).
+        entry = None
+        if self._log_subscribers != 0:
+            entry, stats = _tail_worker_log(w, final=True)
+            self._log_account(stats)
         if entry:
             t = spawn(
                 self._publish_worker_logs([entry])
@@ -1072,7 +1194,13 @@ class Raylet:
 
     def rpc_task_events(self, conn: Connection, p):
         """Events from workers executing direct-push tasks; ride the
-        raylet's batched flush to the GCS."""
+        raylet's batched flush to the GCS. Events carrying log offsets
+        also feed the sender's span table, so the tailer can attribute
+        streamed lines to task names."""
+        w = self.workers_by_client.get(conn.meta.get("client_id"))
+        if w is not None:
+            for ev in p["events"]:
+                _feed_log_span(w, ev)
         self._task_events.extend(p["events"])
 
     async def rpc_worker_fate(self, conn: Connection, p):
@@ -1435,7 +1563,21 @@ class Raylet:
                     self.infeasible.setdefault(tid, qt)
 
     async def _run_on_worker(self, qt: _QueuedTask, w: _Worker):
-        self._emit_task_event(qt.spec, "RUNNING", pid=w.proc.pid)
+        # provisional open span at the file's current end: the worker
+        # measures the exact range (its buffers flushed) and reports it
+        # with the result — closed spans override this for attribution
+        extra = {}
+        if w.log_path:
+            try:
+                start = os.path.getsize(w.log_path)
+            except OSError:
+                start = None
+            if start is not None:
+                extra = {"log_file": os.path.basename(w.log_path),
+                         "log_start": start}
+                w.log_spans.open_span(qt.spec.task_id.hex(), qt.spec.name,
+                                      start)
+        self._emit_task_event(qt.spec, "RUNNING", pid=w.proc.pid, **extra)
         try:
             # timeout=0 (unbounded): this await spans the USER CODE's whole
             # runtime — a deadline here would falsely kill long tasks and
@@ -1458,12 +1600,22 @@ class Raylet:
             return
         if w.actor_id is None and not w.conn.closed:
             self._return_worker(w)
+        span = result.get("log_span")
+        if span:
+            extra = {"log_file": span["file"], "log_start": span["start"],
+                     "log_end": span["end"]}
+            w.log_spans.close_span(qt.spec.task_id.hex(), qt.spec.name,
+                                   span["start"], span["end"])
+        else:
+            extra = {}
+            w.log_spans.discard(qt.spec.task_id.hex())
         if result.get("error") is not None:
             self._emit_task_event(qt.spec, "FAILED", pid=w.proc.pid,
-                                  error=str(result.get("error"))[:200])
+                                  error=str(result.get("error"))[:200],
+                                  **extra)
         else:
             self._emit_task_event(qt.spec, "FINISHED", pid=w.proc.pid,
-                                  duration=result.get("duration"))
+                                  duration=result.get("duration"), **extra)
         await self._deliver_result(qt.spec, result)
         self._dispatch_event.set()
 
@@ -1688,6 +1840,10 @@ class Raylet:
             log_dir,
             f"worker-{self.node_id[:8]}-{self._worker_seq}.out",
         )
+        # the worker measures its own log offsets around user code for
+        # per-task attribution (logplane.stdio_offset); RAY_TPU_ prefix
+        # rides the container env filter like the spawn id does
+        env["RAY_TPU_WORKER_LOG_FILE"] = log_file
         argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         cidfile = None
         container = (runtime_env or {}).get("container")
@@ -1809,6 +1965,9 @@ class Raylet:
             return {"error": reply["error"]}
         w.actor_id = spec.actor_id
         w.actor_resources = dict(spec.resources)
+        # streamed-line fallback prefix: anything this worker prints
+        # outside a method's span attributes to the actor class
+        w.log_name = spec.name
         self.local_actors[spec.actor_id] = w
         return {"worker_client_id": w.client_id,
                 "direct_addr": (self.host, w.direct_port)

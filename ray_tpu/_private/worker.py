@@ -70,6 +70,16 @@ def _tracing_ctx():
         return None
 
 
+def _log_span_fields(result: dict) -> dict:
+    """Task-event fields from an executor result's exact log byte range
+    (see logplane.attach_result_span)."""
+    span = result.get("log_span")
+    if not span:
+        return {}
+    return {"log_file": span["file"], "log_start": span["start"],
+            "log_end": span["end"]}
+
+
 class GetTimeoutError(TimeoutError):
     pass
 
@@ -1578,8 +1588,13 @@ class CoreWorker:
         direct = conn is not self.raylet
         specs = p["specs"]
         if direct:
+            # one provisional log offset for the whole batch (items run
+            # sequentially; each FINISHED event carries its exact range)
+            from ray_tpu._private import logplane
+
+            open_fields = logplane.open_event_fields()
             for spec in specs:
-                self._emit_direct_task_event(spec, "RUNNING")
+                self._emit_direct_task_event(spec, "RUNNING", **open_fields)
 
         buf: list = []
         flush_ref: list = [None]
@@ -1602,14 +1617,16 @@ class CoreWorker:
             # RESPONSE is only a completion ack, so ray.wait sees early
             # tasks while the batch tail still runs.
             if direct:
+                extra = _log_span_fields(result)
                 if result.get("error") is not None:
                     self._emit_direct_task_event(
                         spec, "FAILED",
-                        error=str(result.get("error"))[:200],
+                        error=str(result.get("error"))[:200], **extra,
                     )
                 else:
                     self._emit_direct_task_event(
                         spec, "FINISHED", duration=result.get("duration"),
+                        **extra,
                     )
                 if result.get("stored_objects"):
                     try:
@@ -1648,18 +1665,24 @@ class CoreWorker:
         if direct:
             # the raylet never sees direct-push tasks, so this worker owns
             # their observability record (state API / timeline parity with
-            # raylet-routed tasks)
-            self._emit_direct_task_event(spec, "RUNNING")
+            # raylet-routed tasks); log offsets ride along so the raylet's
+            # tailer can attribute streamed lines by byte range
+            from ray_tpu._private import logplane
+
+            self._emit_direct_task_event(spec, "RUNNING",
+                                         **logplane.open_event_fields())
         result = await ex.execute_task(spec)
         if direct:
+            extra = _log_span_fields(result)
             if result.get("error") is not None:
                 self._emit_direct_task_event(
                     spec, "FAILED",
-                    error=str(result.get("error"))[:200],
+                    error=str(result.get("error"))[:200], **extra,
                 )
             else:
                 self._emit_direct_task_event(
                     spec, "FINISHED", duration=result.get("duration"),
+                    **extra,
                 )
             if result.get("stored_objects"):
                 # stored outputs must be self-reported for location tracking
@@ -1701,11 +1724,44 @@ class CoreWorker:
         except Exception:
             pass
 
+    def flush_task_events_sync(self, timeout: float = 2.0):
+        """Push any buffered task events to the raylet NOW, from any
+        thread. Exit paths call this (worker_main's SIGTERM/atexit hooks)
+        so a dying worker's last events — the most interesting ones in a
+        chaos lane — are not lost with the process."""
+        if not self._tev_buf:
+            return
+        buf, self._tev_buf = self._tev_buf, []
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.raylet.notify("task_events", {"events": buf}),
+                self.io.loop,
+            )
+            fut.result(timeout=timeout)
+        except Exception:
+            pass
+
     async def rpc_become_actor(self, conn: Connection, p):
         ex = await self._await_executor()
         return await ex.become_actor(p["spec"])
 
-    def rpc_exit(self, conn: Connection, p):
+    async def rpc_exit(self, conn: Connection, p):
+        # drain observability buffers before dying: buffered task events
+        # go to the raylet (we are ON the io loop — notify directly), and
+        # stdio flushes so the log tailer's final drain sees everything
+        buf, self._tev_buf = self._tev_buf, []
+        if buf:
+            try:
+                await self.raylet.notify("task_events", {"events": buf})
+            except Exception:
+                pass
+        try:
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
         logging.shutdown()
         os._exit(0)
 

@@ -4,11 +4,30 @@ the task executor, and serve until told to exit."""
 
 from __future__ import annotations
 
+import atexit
 import logging
 import os
+import signal
+import sys
 import threading
 
 from ray_tpu._private.jax_pin import _pin_jax_platform_on_import
+
+
+def _flush_observability(cw):
+    """Best-effort drain of this worker's observability buffers: buffered
+    task events go to the raylet and stdio flushes into the log file, so
+    the last records of a dying task — exactly the ones a chaos lane
+    wants — survive the process. Safe to call more than once."""
+    try:
+        cw.flush_task_events_sync()
+    except Exception:
+        pass
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
 
 
 def main():
@@ -32,6 +51,21 @@ def main():
         gcs_port=int(gcs_port),
         is_driver=False,
     )
+    # Exit flushing: a graceful kill (raylet stop/reclaim sends SIGTERM),
+    # a normal interpreter exit, and a fatal error below all drain the
+    # task-event buffer + stdio first. SIGKILL/segfaults are out of reach,
+    # but the raylet's final log drain still recovers their stdio tail.
+    atexit.register(_flush_observability, cw)
+
+    def _on_sigterm(signum, frame):
+        _flush_observability(cw)
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: atexit still covers us
+
     # Materialize this worker's runtime env (working_dir/py_modules URIs)
     # BEFORE attaching the executor: the pool keys workers by env hash, so
     # every task routed here expects the env to be in place.
@@ -52,12 +86,29 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         _pin_jax_platform_on_import(os.environ["JAX_PLATFORMS"])
 
-    TaskExecutor(cw)
-    global_worker.core_worker = cw
-    global_worker.mode = "worker"
-    # Exit when our raylet goes away (the raylet owns worker lifetimes).
-    cw.raylet.on_close = lambda _conn: os._exit(0)
-    threading.Event().wait()  # serve forever; raylet kills us on shutdown
+    try:
+        TaskExecutor(cw)
+        global_worker.core_worker = cw
+        global_worker.mode = "worker"
+
+        # Exit when our raylet goes away (the raylet owns worker
+        # lifetimes). Runs ON the io loop: only stdio can flush here —
+        # the event buffer's target (the raylet) is gone anyway, and
+        # flush_task_events_sync would deadlock the loop on itself.
+        def _raylet_gone(_conn):
+            try:
+                sys.stdout.flush()
+                sys.stderr.flush()
+            except Exception:
+                pass
+            os._exit(0)
+
+        cw.raylet.on_close = _raylet_gone
+        threading.Event().wait()  # serve forever; raylet kills us on shutdown
+    finally:
+        # fatal path (executor attach/materialize blew up): the traceback
+        # printed above must reach the log file before the process dies
+        _flush_observability(cw)
 
 
 if __name__ == "__main__":

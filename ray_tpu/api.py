@@ -127,7 +127,10 @@ def init(
         )
         global_worker.core_worker = cw
         global_worker.mode = "driver"
-        if log_to_driver:
+        # both gates must agree: the init() kwarg and the config flag
+        # (RAY_TPU_LOG_TO_DRIVER=0 kills streaming cluster-wide without
+        # touching code; with no subscribers, raylets skip tailing too)
+        if log_to_driver and cfg.log_to_driver:
             _subscribe_worker_logs(cw)
         # local usage snapshot (reference: usage_lib's session report;
         # this build never phones home — see usage_lib docstring)
@@ -144,28 +147,87 @@ def init(
         return RayContext(address, cw.node_id)
 
 
+# per-worker prefix colors (ray parity: worker.py cycles colors by pid so
+# interleaved workers stay tellable apart); 36=cyan first for continuity
+_LOG_COLORS = (36, 35, 33, 32, 34, 31)
+
+
 def _subscribe_worker_logs(cw):
     """Print worker stdout/stderr on the driver (ray parity:
     _private/log_monitor.py + worker.py print_logs — lines arrive over
-    GCS pubsub from each raylet's log tailer; entries are tagged with the
-    worker's job so concurrent drivers only see their own job's output)."""
+    GCS pubsub from each raylet's log tailer, attributed to tasks by
+    byte-offset spans, and render as ``(<TaskName> pid=<pid>
+    node=<id8>)``-prefixed lines; identical lines fanning in from many
+    workers collapse through a dedup window into one ``[repeated Nx]``
+    summary. Entries are tagged with the worker's job so concurrent
+    drivers only see their own job's output)."""
     import sys
+    import time as _time
+
+    from ray_tpu._private import logplane, metrics_core
 
     my_job = cw.job_id.hex() if cw.job_id else None
+    dedup = logplane.LogDeduplicator(window_s=cfg.log_dedup_window_s)
+    # self-measurement: printed-line count + handler CPU for the
+    # BENCH_LOG_OVERHEAD lane (snapshot-time callbacks, zero hot-path
+    # cost beyond the dict writes below)
+    stats = {"lines": 0, "seconds": 0.0}
+    reg = metrics_core.registry()
+    ltags = {"channel": "logs"}
+    reg.counter("driver_log_lines_printed_total",
+                "Streamed worker log lines printed by this driver"
+                ).labels(**ltags).set_fn(lambda: stats["lines"])
+    reg.counter("driver_log_handler_seconds_total",
+                "CPU seconds in the driver's log-print handler"
+                ).labels(**ltags).set_fn(lambda: stats["seconds"])
 
     def on_logs(msg):
+        # thread_time: CPU actually burned here, not GIL-contended wall
+        t0 = _time.thread_time()
         node = (msg.get("node_id") or "")[:8]
+        out = []
         for entry in msg.get("workers", ()):
             job = entry.get("job_id")
             if job is not None and my_job is not None and job != my_job:
                 continue
             pid = entry.get("pid")
-            for line in entry.get("lines", ()):
-                print(f"\x1b[36m(pid={pid}, node={node})\x1b[0m {line}",
-                      file=sys.stderr)
+            color = _LOG_COLORS[(pid or 0) % len(_LOG_COLORS)]
+            # "segs" groups consecutive lines by attributed task name
+            for name, lines in entry.get("segs") or ():
+                label = f"{name} pid={pid} node={node}" if name \
+                    else f"pid={pid} node={node}"
+                prefix = f"\x1b[{color}m({label})\x1b[0m "
+                for line in lines:
+                    out.extend(dedup.feed(prefix, line))
+        out.extend(dedup.flush())
+        if out:
+            print("\n".join(out), file=sys.stderr)
+            stats["lines"] += len(out)
+        stats["seconds"] += _time.thread_time() - t0
+
+    async def _summary_flusher():
+        # a quiet stream must still surface its pending [repeated Nx]
+        # summaries: without this tick they would wait for the NEXT log
+        # message (or shutdown), hiding how many workers really printed
+        import asyncio
+
+        while True:
+            await asyncio.sleep(max(0.25, cfg.log_dedup_window_s))
+            try:
+                out = dedup.flush()
+                if out:
+                    print("\n".join(out), file=sys.stderr)
+                    stats["lines"] += len(out)
+            except Exception:
+                pass
 
     try:
-        cw.subscribe("worker_log", on_logs)
+        cw.subscribe("logs", on_logs)
+        cw._log_dedup = dedup  # shutdown drains the last summaries
+        import asyncio as _asyncio
+
+        cw._log_flush_task = _asyncio.run_coroutine_threadsafe(
+            _summary_flusher(), cw.io.loop)
     except Exception:
         pass  # logs stay in session files
 
@@ -174,6 +236,18 @@ def shutdown():
     with _init_lock:
         cw = global_worker.core_worker
         if cw is not None:
+            task = getattr(cw, "_log_flush_task", None)
+            if task is not None:
+                task.cancel()
+            dedup = getattr(cw, "_log_dedup", None)
+            if dedup is not None:
+                # drain pending [repeated Nx] summaries before the pubsub
+                # subscription dies with the connection
+                import sys
+
+                tail = dedup.flush(force=True)
+                if tail:
+                    print("\n".join(tail), file=sys.stderr)
             try:
                 cw.disconnect()
             except Exception:

@@ -15,6 +15,8 @@ Routes:
       merged; also at /api/v0/metrics, ?format=json for raw snapshots)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
+  GET /api/v0/logs/range?file=<name>&start=A&end=B — exact byte range
+      (the log plane's per-task attribution spans resolve through this)
 """
 
 from __future__ import annotations
@@ -23,7 +25,11 @@ import argparse
 import asyncio
 import json
 import os
-from typing import Optional
+from typing import List, Optional, Tuple
+
+# hard ceiling on one range/tail read (a bad span or a huge `lines`
+# must not buffer an entire multi-GB log into one HTTP response)
+MAX_READ_BYTES = 8 * 1024 * 1024
 
 
 def _json(payload, status=200):
@@ -33,6 +39,55 @@ def _json(payload, status=200):
         text=json.dumps(payload, default=str),
         content_type="application/json", status=status,
     )
+
+
+def safe_log_name(name: str) -> bool:
+    """Session-log filenames only: no traversal, no absolute paths, no
+    dotfiles (the token file lives one directory up)."""
+    return bool(name) and "/" not in name and "\\" not in name \
+        and not name.startswith(".")
+
+
+def tail_file(path: str, lines: int) -> Tuple[List[str], int]:
+    """Last ``lines`` full lines of ``path``. The read window SCALES with
+    the request (doubling until enough newlines are in view or BOF) —
+    the old fixed 256 KiB window silently truncated large requests — and
+    a window that starts mid-file drops its torn leading partial line.
+    Returns (lines, start_offset_of_first_returned_byte, end_offset)."""
+    lines = max(1, min(int(lines), 100_000))
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        window = min(max(64 * 1024, lines * 256), MAX_READ_BYTES)
+        while True:
+            start = max(0, size - window)
+            f.seek(start)
+            data = f.read(size - start)
+            # need lines+1 newlines so `lines` COMPLETE lines survive
+            # dropping the torn head; at BOF or the byte ceiling, take
+            # what is there
+            if start == 0 or data.count(b"\n") > lines \
+                    or window >= MAX_READ_BYTES:
+                break
+            window *= 2
+    raw = data.split(b"\n")
+    if start > 0:
+        start += len(raw[0]) + 1
+        raw = raw[1:]  # torn leading partial line
+    if raw and not raw[-1]:
+        raw.pop()  # trailing newline artifact
+    cut = raw[-lines:]
+    start += sum(len(r) + 1 for r in raw[: len(raw) - len(cut)])
+    return [r.decode("utf-8", "replace") for r in cut], start, size
+
+
+def read_range(path: str, start: int, end: int) -> bytes:
+    """Exact byte range [start, end) of a log file, ceiling-capped."""
+    start = max(0, int(start))
+    end = max(start, int(end))
+    with open(path, "rb") as f:
+        f.seek(start)
+        return f.read(min(end - start, MAX_READ_BYTES))
 
 
 class Agent:
@@ -112,25 +167,55 @@ class Agent:
             pass
         return _json(out)
 
-    async def tail(self, request):
+    def _log_path(self, request):
         name = request.query.get("file", "")
+        if not safe_log_name(name):
+            return None, _json({"error": "bad file name"}, status=400)
+        return os.path.join(self.session_dir, "logs", name), None
+
+    async def tail(self, request):
+        path, err = self._log_path(request)
+        if err is not None:
+            return err
         try:
             lines = int(request.query.get("lines", "100"))
         except ValueError:
             return _json({"error": "lines must be an integer"}, status=400)
-        if "/" in name or name.startswith("."):
-            return _json({"error": "bad file name"}, status=400)
-        path = os.path.join(self.session_dir, "logs", name)
         try:
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - 256 * 1024))
-                text = f.read().decode("utf-8", "replace")
+            out, start, end = tail_file(path, lines)
         except OSError:
             return _json({"error": "no such log"}, status=404)
-        return _json({"file": name,
-                      "lines": text.splitlines()[-lines:]})
+        return _json({"file": request.query["file"], "lines": out,
+                      "start": start, "end": end})
+
+    async def range(self, request):
+        """Exact byte range of one log file — how per-task attribution
+        spans (log_file, log_start, log_end on task events) resolve to
+        the task's actual output."""
+        path, err = self._log_path(request)
+        if err is not None:
+            return err
+        try:
+            start = int(request.query.get("start", "0"))
+            end = int(request.query.get("end", "0"))
+        except ValueError:
+            return _json({"error": "start/end must be integers"}, status=400)
+        try:
+            data = read_range(path, start, end)
+        except OSError:
+            return _json({"error": "no such log"}, status=404)
+        text = data.decode("utf-8", "replace")
+        out = text.split("\n")
+        if out and not out[-1]:
+            out.pop()
+        # end_complete: offset just past the last NEWLINE in the range —
+        # followers resume there so a line caught mid-write is never
+        # yielded as two torn halves
+        last_nl = data.rfind(b"\n")
+        end_complete = start + (last_nl + 1 if last_nl >= 0 else 0)
+        return _json({"file": request.query["file"], "start": start,
+                      "bytes": len(data), "end_complete": end_complete,
+                      "lines": out})
 
 
 async def amain(args) -> None:
@@ -150,6 +235,7 @@ async def amain(args) -> None:
     app.router.add_get("/api/v0/metrics", agent.metrics)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
+    app.router.add_get("/api/v0/logs/range", agent.range)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", args.port)

@@ -146,6 +146,64 @@ def _build_app():
         {ts, metrics} summaries the SPA renders as sparklines."""
         return _json_response(list(_metrics_history))
 
+    @routes.get("/api/v0/logs")
+    async def logs_listing(request):
+        """Cluster log listing: head fans to every node agent
+        (?node_id= narrows, prefix ok)."""
+        node_id = request.query.get("node_id")
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.list_logs(node_id=node_id)
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/logs/tail")
+    async def logs_tail(request):
+        """Tail one log file anywhere in the cluster:
+        ?node_id=&file=&lines=N."""
+        q = request.query
+        if not q.get("file"):
+            return _json_response({"error": "file required"}, status=400)
+        try:
+            lines = int(q.get("lines", "100"))
+        except ValueError:
+            return _json_response({"error": "lines must be an integer"},
+                                  status=400)
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.get_log(
+                    filename=q["file"], node_id=q.get("node_id") or None,
+                    tail=lines,
+                )
+            )
+        except Exception as e:
+            return _json_response({"error": str(e)}, status=404)
+        return _json_response({"file": q["file"], "lines": out})
+
+    @routes.get("/api/v0/logs/task")
+    async def logs_task(request):
+        """A task's exact output via its attribution span:
+        ?task_id=<hex> (or ?actor_id=<hex> for the actor's worker log)."""
+        q = request.query
+        task_id = q.get("task_id") or None
+        actor_id = q.get("actor_id") or None
+        if not task_id and not actor_id:
+            return _json_response({"error": "task_id or actor_id required"},
+                                  status=400)
+        try:
+            tail = int(q["tail"]) if q.get("tail") else None
+        except ValueError:
+            return _json_response({"error": "tail must be an integer"},
+                                  status=400)
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.get_log(task_id=task_id,
+                                            actor_id=actor_id, tail=tail)
+            )
+        except Exception as e:
+            return _json_response({"error": str(e)}, status=404)
+        return _json_response({"task_id": task_id, "actor_id": actor_id,
+                               "lines": out})
+
     @routes.get("/api/v0/stacks")
     async def stacks(request):
         node_id = request.query.get("node_id")

@@ -296,6 +296,103 @@ def cmd_metrics(args):
         ray_tpu.shutdown()
 
 
+def cmd_logs(args):
+    """ray parity: `ray logs` — the cluster log plane's CLI. With no
+    target, prints the cluster log listing (every node agent's files).
+    `task <id>` returns exactly that task's output via its attribution
+    byte range (offsets stamped by the executor, not a grep); `actor
+    <id>` tails the actor worker's log; `worker|gcs|raylet` tail the
+    matching session files."""
+    import re
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    # log_to_driver=False: this CLI must not re-stream the logs it is
+    # about to print explicitly
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli",
+                 log_to_driver=False)
+    pat = re.compile(args.grep) if args.grep else None
+
+    def emit(lines, prefix=""):
+        for ln in lines:
+            if pat and not pat.search(ln):
+                continue
+            print(f"{prefix}{ln}")
+
+    try:
+        target = args.target
+        if target is None:
+            for nid, files in state.list_logs(node_id=args.node).items():
+                print(f"=== node {nid[:12]} ===")
+                if isinstance(files, dict):
+                    print(f"  ({files.get('error', 'unavailable')})")
+                    continue
+                for f in files:
+                    print(f"  {f['bytes']:>12,d}  {f['file']}")
+            return
+        # file tails default to the last 100 lines; the TASK target must
+        # not truncate silently — its contract is the task's EXACT output
+        tail = args.tail if args.tail is not None else 100
+        if target == "task":
+            if not args.ident:
+                sys.exit("usage: ray_tpu logs task <task_id_hex>")
+            emit(state.get_log(task_id=args.ident, tail=args.tail))
+            return
+        if target == "actor":
+            if not args.ident:
+                sys.exit("usage: ray_tpu logs actor <actor_id_hex>")
+            out = state.get_log(actor_id=args.ident, tail=tail,
+                                follow=args.follow)
+            if args.follow:
+                try:
+                    for ln in out:
+                        emit([ln])
+                except KeyboardInterrupt:
+                    return
+            else:
+                emit(out)
+            return
+        # file targets: worker|gcs|raylet [filename]
+        prefixes = {"worker": "worker-", "gcs": "gcs.", "raylet": "raylet_"}
+        if target not in prefixes:
+            sys.exit(f"unknown logs target {target!r} "
+                     f"(task|actor|worker|gcs|raylet)")
+        if args.ident:
+            files = [(args.node, args.ident)]
+        else:
+            files = []
+            for nid, listing in state.list_logs(node_id=args.node).items():
+                if isinstance(listing, dict):
+                    continue
+                files.extend(
+                    (nid, f["file"]) for f in listing
+                    if f["file"].startswith(prefixes[target]))
+        if not files:
+            sys.exit(f"no {target} log files found")
+        if args.follow:
+            if len(files) > 1:
+                sys.exit(f"--follow needs one file; matched "
+                         f"{[f for _, f in files]} (pass the filename)")
+            nid, fname = files[0]
+            try:
+                for ln in state.get_log(filename=fname, node_id=nid,
+                                        tail=tail, follow=True):
+                    emit([ln])
+            except KeyboardInterrupt:
+                return
+            return
+        for nid, fname in files:
+            prefix = f"[{fname}] " if len(files) > 1 else ""
+            try:
+                emit(state.get_log(filename=fname, node_id=nid,
+                                   tail=tail), prefix=prefix)
+            except ValueError as e:
+                print(f"{prefix}({e})", file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_events(args):
     import ray_tpu
     from ray_tpu.util import events as ev
@@ -572,6 +669,27 @@ def main(argv=None):
     p.add_argument("-o", "--output", help="write Prometheus text here")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "logs",
+        help="cluster log plane: listing, per-task/actor output, tails",
+    )
+    p.add_argument("target", nargs="?",
+                   choices=["task", "actor", "worker", "gcs", "raylet"],
+                   help="omit for the cluster log listing")
+    p.add_argument("ident", nargs="?",
+                   help="task/actor id hex, or an explicit filename for "
+                        "worker|gcs|raylet")
+    p.add_argument("--node", help="node id (prefix ok)")
+    p.add_argument("--tail", type=int,
+                   help="lines from the end (default 100 for file "
+                        "targets; task output is never truncated "
+                        "unless set)")
+    p.add_argument("--follow", action="store_true",
+                   help="keep polling the file as it grows (one file)")
+    p.add_argument("--grep", help="only print lines matching this regex")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("events", help="show structured cluster events")
     p.add_argument("--address")

@@ -24,6 +24,8 @@ __all__ = [
     "list_placement_groups",
     "list_jobs",
     "list_workers",
+    "list_logs",
+    "get_log",
     "summarize_tasks",
     "get_node_stats",
     "get_stacks",
@@ -69,10 +71,14 @@ def list_actors(filters: Optional[Iterable[Tuple]] = None,
 
 
 def list_tasks(filters: Optional[Iterable[Tuple]] = None,
-               limit: Optional[int] = None) -> List[dict]:
+               limit: Optional[int] = None,
+               events_limit: Optional[int] = None) -> List[dict]:
     """Latest known state per task, derived from the task-event log
-    (ray parity: `ray list tasks` via gcs_task_manager.h)."""
-    events = _gcs_request("list_task_events", {"limit": 100_000})
+    (ray parity: `ray list tasks` via gcs_task_manager.h).
+    ``events_limit`` caps how many raw events are fetched from the GCS
+    (default 100k — the full buffer at the default config)."""
+    events = _gcs_request("list_task_events",
+                          {"limit": events_limit or 100_000})
     latest: dict = {}
     for ev in events:
         if ev.get("state") == "SPAN":  # tracing spans share the event log
@@ -123,6 +129,187 @@ def list_workers(filters: Optional[Iterable[Tuple]] = None,
         if stats is not None:
             rows.append(stats)
     return _apply_filters(rows, filters, limit)
+
+
+# ---------------------------------------------------------------------------
+# cluster log plane (ray parity: ray.util.state.list_logs/get_log —
+# dashboard/modules/log; here the head fans to per-node agents over HTTP)
+# ---------------------------------------------------------------------------
+
+def _agent_addr(node: dict) -> Optional[str]:
+    """Base URL of a node's dashboard agent (port from the GCS KV the
+    agent registered at boot)."""
+    port = _gcs_request("kv_get", {"ns": b"node_agents",
+                                   "key": node["node_id"].encode()})
+    if not port:
+        return None
+    return f"http://{node['host']}:{int(port.decode())}"
+
+
+def _match_node(node: dict, node_id: Optional[str]) -> bool:
+    return node_id is None or node["node_id"] == node_id \
+        or node["node_id"].startswith(node_id)
+
+
+def list_logs(node_id: Optional[str] = None,
+              timeout: float = 30.0) -> dict:
+    """Log files per node: ``{node_id: [{"file", "bytes"}, ...]}``
+    (``node_id`` may be a prefix). Fans head->agents; nodes without a
+    reachable agent report ``{"error": ...}``."""
+    import requests
+
+    out: dict = {}
+    for node in _gcs_request("get_nodes"):
+        if not node.get("alive") or not _match_node(node, node_id):
+            continue
+        base = _agent_addr(node)
+        if base is None:
+            out[node["node_id"]] = {"error": "no node agent"}
+            continue
+        try:
+            r = requests.get(f"{base}/api/v0/logs", timeout=timeout)
+            out[node["node_id"]] = r.json()
+        except Exception as e:
+            out[node["node_id"]] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _task_log_event(task_id: Optional[str] = None,
+                    actor_id: Optional[str] = None) -> Optional[dict]:
+    """Latest task event carrying log attribution for a task/actor."""
+    best = None
+    for ev in _gcs_request("list_task_events", {"limit": 100_000}):
+        if task_id is not None and ev.get("task_id") != task_id:
+            continue
+        if actor_id is not None and ev.get("actor_id") != actor_id:
+            continue
+        if ev.get("log_file") is None:
+            continue
+        if best is None or (ev["ts"], ev.get("log_end") is not None) >= \
+                (best["ts"], best.get("log_end") is not None):
+            best = ev
+    return best
+
+
+def _agent_for_node_id(node_id: str, strict: bool = True) -> Optional[str]:
+    for node in _gcs_request("get_nodes"):
+        if node["node_id"] == node_id or node["node_id"].startswith(node_id):
+            return _agent_addr(node)
+    return None
+
+
+def get_log(filename: Optional[str] = None,
+            task_id: Optional[str] = None,
+            actor_id: Optional[str] = None,
+            node_id: Optional[str] = None,
+            tail: Optional[int] = None,
+            follow: bool = False,
+            timeout: float = 30.0):
+    """Fetch log lines by filename, task id, or actor id.
+
+    - ``task_id``: the task's EXACT output — resolved through the
+      attribution span (log_file, log_start, log_end) its executor
+      stamped on the FINISHED/FAILED task event, read back as a byte
+      range from that node's agent. Not a grep.
+    - ``actor_id``: the actor worker's log file (located via the actor's
+      latest attributed event), tailed.
+    - ``filename``: that session log file (``node_id`` narrows the
+      search; without it every alive node is probed).
+
+    Returns a list of lines, or a generator of lines when ``follow=True``
+    (filename/actor mode only: polls the file as it grows).
+    """
+    import requests
+
+    if sum(x is not None for x in (filename, task_id, actor_id)) != 1:
+        raise ValueError("pass exactly one of filename, task_id, actor_id")
+
+    if task_id is not None:
+        ev = _task_log_event(task_id=task_id)
+        if ev is None:
+            raise ValueError(f"no log attribution recorded for task "
+                             f"{task_id} (still running, or pruned)")
+        base = _agent_for_node_id(ev["node_id"])
+        if base is None:
+            raise RuntimeError(f"node agent for {ev['node_id'][:12]} "
+                               f"unreachable")
+        end = ev.get("log_end")
+        if end is None:
+            # still running: read start -> EOF (current size via listing)
+            files = requests.get(f"{base}/api/v0/logs",
+                                 timeout=timeout).json()
+            end = next((f["bytes"] for f in files
+                        if f["file"] == ev["log_file"]), ev["log_start"])
+        r = requests.get(f"{base}/api/v0/logs/range", params={
+            "file": ev["log_file"], "start": ev["log_start"], "end": end,
+        }, timeout=timeout)
+        lines = r.json().get("lines", [])
+        return lines[-tail:] if tail else lines
+
+    if actor_id is not None:
+        ev = _task_log_event(actor_id=actor_id)
+        if ev is None:
+            raise ValueError(f"no log attribution recorded for actor "
+                             f"{actor_id}")
+        filename, node_id = ev["log_file"], ev["node_id"]
+
+    # filename mode (possibly via actor_id above)
+    base = None
+    if node_id is not None:
+        base = _agent_for_node_id(node_id)
+    else:
+        for node in _gcs_request("get_nodes"):
+            if not node.get("alive"):
+                continue
+            cand = _agent_addr(node)
+            if cand is None:
+                continue
+            try:
+                files = requests.get(f"{cand}/api/v0/logs",
+                                     timeout=timeout).json()
+            except Exception:
+                continue
+            if any(f.get("file") == filename for f in files):
+                base = cand
+                break
+    if base is None:
+        raise ValueError(f"log file {filename!r} not found on any "
+                         f"reachable node agent")
+    r = requests.get(f"{base}/api/v0/logs/tail", params={
+        "file": filename, "lines": tail or 100,
+    }, timeout=timeout)
+    payload = r.json()
+    if payload.get("error"):
+        raise ValueError(payload["error"])
+    if not follow:
+        return payload["lines"]
+
+    def _follow():
+        import time as _time
+
+        offset = payload.get("end", 0)
+        yield from payload["lines"]
+        while True:
+            _time.sleep(1.0)
+            rr = requests.get(f"{base}/api/v0/logs/range", params={
+                "file": filename, "start": offset, "end": offset + 2**20,
+            }, timeout=timeout).json()
+            if rr.get("error"):
+                # rotated/removed file must surface, not spin silently
+                raise RuntimeError(
+                    f"following {filename!r} failed: {rr['error']}")
+            got = rr.get("lines") or []
+            # resume at the last complete line: a line caught mid-write
+            # stays unread until its newline lands, instead of being
+            # yielded as two torn halves across polls
+            new_offset = rr.get("end_complete",
+                                offset + rr.get("bytes", 0))
+            if rr.get("bytes", 0) > new_offset - offset and got:
+                got.pop()  # trailing partial held for the next poll
+            offset = new_offset
+            yield from got
+
+    return _follow()
 
 
 def _node_request(node: dict, method: str, payload=None,
@@ -214,18 +401,41 @@ def summarize_tasks() -> dict:
     return summary
 
 
-def timeline(filename: Optional[str] = None) -> list:
+def timeline(filename: Optional[str] = None,
+             limit: Optional[int] = None) -> list:
     """Chrome-trace dump of the task-event log (ray parity:
     `ray timeline` — _private/state.py:416 chrome_tracing_dump). Load the
-    output in chrome://tracing or Perfetto."""
+    output in chrome://tracing or Perfetto. Tracing spans (util.tracing)
+    ride the same event log and render as their own "span" slices, so a
+    driver-opened span and its worker-side execution child land in one
+    trace. ``limit`` caps the raw events fetched (default 100k)."""
     import json
 
-    events = _gcs_request("list_task_events", {"limit": 100_000})
+    events = _gcs_request("list_task_events", {"limit": limit or 100_000})
     # Pair RUNNING -> FINISHED/FAILED into complete ("X") slices.
     running: dict = {}
     trace = []
     for ev in sorted(events, key=lambda e: e["ts"]):
         key = (ev["task_id"], ev.get("attempt", 0))
+        if ev["state"] == "SPAN":
+            # distributed-tracing span (tracing.py flush): already a
+            # complete interval — emit directly
+            trace.append({
+                "name": ev["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": ev.get("span_start", ev["ts"]) * 1e6,
+                "dur": max(ev.get("duration", 0.0) * 1e6, 1.0),
+                "pid": (ev.get("node_id") or "")[:8],
+                "tid": ev.get("pid", 0),
+                "args": {
+                    "trace_id": ev.get("trace_id"),
+                    "span_id": ev["task_id"],
+                    "parent_span_id": ev.get("parent_span_id"),
+                    "attributes": ev.get("attributes", {}),
+                },
+            })
+            continue
         if ev["state"] == "RUNNING":
             running[key] = ev
         elif ev["state"] in ("FINISHED", "FAILED") and key in running:

@@ -215,11 +215,13 @@ def flush():
         pass
 
 
-def get_spans(trace_id: Optional[str] = None) -> List[dict]:
-    """Spans recorded cluster-wide (from the GCS task-event log)."""
+def get_spans(trace_id: Optional[str] = None,
+              limit: Optional[int] = None) -> List[dict]:
+    """Spans recorded cluster-wide (from the GCS task-event log).
+    ``limit`` caps the raw events fetched (default 100k)."""
     from ray_tpu.util.state import list_task_events
 
-    spans = [e for e in list_task_events(limit=100_000)
+    spans = [e for e in list_task_events(limit=limit or 100_000)
              if e.get("state") == "SPAN"]
     if trace_id is not None:
         spans = [s for s in spans if s.get("trace_id") == trace_id]

@@ -40,5 +40,17 @@ if [ "$rc" -ne 0 ]; then
     else
         echo "(no live cluster to scrape)" >&2
     fi
+    # Log-plane triage: the cluster log listing plus the last error lines
+    # of the streamed worker logs — what a driver would have seen — so a
+    # crashed task's final output lands next to the failing lane's report.
+    echo "--- cluster log listing ---" >&2
+    timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu logs >&2 2>/dev/null \
+        || echo "(no live cluster to list logs from)" >&2
+    echo "--- last worker error lines (driver-streamed view) ---" >&2
+    timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu logs worker --grep '(?i)error|traceback|fail' \
+        --tail 50 >&2 2>/dev/null \
+        || echo "(no worker logs reachable)" >&2
 fi
 exit "$rc"

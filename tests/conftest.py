@@ -41,6 +41,10 @@ def pytest_configure(config):
         "markers",
         "metrics: metrics-plane test (metrics_core, scrape fan-out, "
         "overhead gate)")
+    config.addinivalue_line(
+        "markers",
+        "logs: log-plane test (attribution spans, streaming dedup, "
+        "tail/range surfaces)")
 
 
 def wait_for_condition(condition, timeout: float = 30.0,

@@ -86,6 +86,46 @@ def test_multihop_propagation(ray_start_regular):
         tracing.disable()
 
 
+def test_driver_span_parents_worker_span_in_timeline(ray_start_regular):
+    """e2e for the cross-process propagation path (tracing.py
+    record_remote_span): a span opened on the DRIVER parents the
+    worker-side execution span, and BOTH render in ray_tpu.timeline()
+    output as complete slices."""
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def traced_leaf():
+            return 7
+
+        with tracing.span("timeline-root") as root:
+            assert ray_tpu.get(traced_leaf.remote(), timeout=60) == 7
+            trace_id = root["trace_id"]
+        tracing.flush()
+
+        spans = _wait_for(
+            lambda: [s for s in tracing.get_spans(trace_id)
+                     if s["name"] == "task::traced_leaf"] or None
+        )
+        root_span = next(s for s in tracing.get_spans(trace_id)
+                         if s["name"] == "timeline-root")
+        # parentage: the worker-side execution span chains to the driver's
+        assert spans[0]["parent_span_id"] == root_span["task_id"]
+
+        trace = ray_tpu.timeline()
+        by_name = {e["name"]: e for e in trace if e.get("cat") == "span"}
+        assert "timeline-root" in by_name, "driver span missing in timeline"
+        assert "task::traced_leaf" in by_name, "worker span missing"
+        child, parent = by_name["task::traced_leaf"], by_name["timeline-root"]
+        # same trace, linked parent, and the child interval nests inside
+        assert child["args"]["trace_id"] == parent["args"]["trace_id"]
+        assert child["args"]["parent_span_id"] == parent["args"]["span_id"]
+        assert child["ts"] >= parent["ts"] - 1e3  # clock skew slack (us)
+        # the limit= knob caps the raw event fetch without breaking shape
+        assert isinstance(ray_tpu.timeline(limit=5), list)
+    finally:
+        tracing.disable()
+
+
 def test_disabled_tracing_is_noop(ray_start_regular):
     tracing.disable()
     with tracing.span("nope") as rec:
