@@ -357,6 +357,36 @@ def _log_overhead_main():
     os._exit(0)
 
 
+def _object_plane_main():
+    """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — put/get at
+    100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram path). Gated on
+    the structural invariant (bulk sizes slab-backed = the arena data
+    path is live, not the file fallback); throughputs are reported for
+    the BENCH_CORE A/B. Emits ONE JSON line, same contract as the
+    default bench path."""
+    import ray_tpu
+    from ray_tpu._private.perf import run_object_plane_bench
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    ray_tpu.init(num_cpus=2)
+    try:
+        rows = run_object_plane_bench(small=small)
+    finally:
+        ray_tpu.shutdown()
+    bulk = [r for r in rows if r["bytes"] > 100 * 1024]
+    one_mb = next((r for r in rows
+                   if r["benchmark"] == "obj get 1MB"), {})
+    print(json.dumps({
+        "metric": "object_plane_get_1mb_ops_per_sec",
+        "value": one_mb.get("value", 0.0),
+        "unit": "ops/s",
+        "vs_baseline": 1.0 if bulk and all(r["slab_backed"] for r in bulk)
+        else 0.0,
+        "detail": rows,
+    }), flush=True)
+    os._exit(0)
+
+
 def main():
     signal.signal(signal.SIGTERM, _emit_and_exit)
     threading.Thread(target=_watchdog_thread, daemon=True).start()
@@ -367,6 +397,8 @@ def main():
         _metrics_overhead_main()
     if os.environ.get("BENCH_LOG_OVERHEAD"):
         _log_overhead_main()
+    if os.environ.get("BENCH_OBJECT_PLANE"):
+        _object_plane_main()
 
     on_tpu = _tpu_reachable()
 

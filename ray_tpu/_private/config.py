@@ -80,6 +80,13 @@ _flag("worker_niceness", int, 0)
 _flag("max_direct_call_object_size", int, 100 * 1024)  # inline threshold (ray: 100KB)
 _flag("object_store_memory", int, 2 * 1024**3)
 _flag("object_store_eviction_fraction", float, 0.8)
+# Slab-arena object plane (slab_arena.py): leased write slabs + shared
+# index instead of one file per object. RAY_TPU_slab_arena=0 restores the
+# legacy per-object-file data path (and with it the native C++ writer).
+_flag("slab_arena", bool, True)
+_flag("slab_size_bytes", int, 16 * 1024 * 1024)  # default lease ceiling
+_flag("slab_min_lease_bytes", int, 1024 * 1024)  # first lease of a worker
+_flag("slab_index_slots", int, 1 << 16)  # shared index capacity (~4MB)
 _flag("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
 _flag("object_pull_timeout_s", float, 60.0)
 _flag("fetch_warn_timeout_s", float, 10.0)

@@ -598,9 +598,8 @@ class TaskExecutor:
                 results.append(("v", sv.metadata, sv.to_wire()))
             else:
                 oid = ObjectID.from_index(tid, i + 1)
-                object_store.write_object(
-                    self.cw.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
-                )
+                # slab-arena write (batched accounting); one-file fallback
+                self.cw.store_put(oid, sv)
                 stored.append(oid.binary())
                 results.append(("r", oid.binary()))
         if return_pins:
@@ -641,10 +640,7 @@ class TaskExecutor:
             for i, item in enumerate(value):
                 sv = serialization.serialize(item)
                 oid = ObjectID.from_index(tid, i + 2)
-                object_store.write_object(
-                    self.cw.store_dir, oid, sv.metadata, sv.buffers,
-                    sv.total_data_len,
-                )
+                self.cw.store_put(oid, sv)
                 item_oids.append(oid.binary())
                 if sv.nested_refs:
                     # refs escaping inside a yielded value: same handoff as
@@ -656,11 +652,12 @@ class TaskExecutor:
                         return_pins.append(self.cw.pin_object(oid_b, owner))
         except Exception as e:
             # a partial run must not orphan the items already written
+            # (slab entries are marked dead, fallback files unlinked)
             for oid_b in item_oids:
                 try:
-                    os.unlink(object_store._obj_path(
+                    object_store.discard_local(
                         self.cw.store_dir, ObjectID(oid_b)
-                    ))
+                    )
                 except OSError:
                     pass
             for t in return_pins:

@@ -675,6 +675,17 @@ class GcsServer:
                 fut.set_result([node_id])
         return {}
 
+    async def rpc_add_object_locations(self, conn: Connection, p):
+        """Batched variant: one frame per slab-accounting burst (the
+        arena's batched put path registers many objects per tick)."""
+        node_id = p["node_id"]
+        for oid in p["object_ids"]:
+            self.object_dir.setdefault(oid, set()).add(node_id)
+            for fut in self.object_waiters.pop(oid, []):
+                if not fut.done():
+                    fut.set_result([node_id])
+        return {}
+
     async def rpc_remove_object_location(self, conn: Connection, p):
         locs = self.object_dir.get(p["object_id"])
         if locs:

@@ -4,7 +4,21 @@ Analog of ray: python/ray/_private/node.py:37 Node + services.py: starts and
 owns the per-node processes (GCS on the head, a raylet per node), discovers
 their ports via port files, and tears them down on shutdown. Sessions live
 under /dev/shm when available so the object store's files are true shared
-memory.
+memory. Session layout (one dir per cluster session)::
+
+    session_<ts>_<rand>/
+      cluster_token            rpc auth token (0600)
+      gcs_store.log            GCS persistence log
+      logs/                    per-process stdout/stderr
+      store_<node_id12>/       raylet object store (per node)
+        index.shm              shared-memory object index (slab arena)
+        slabs/seg_*.slab       leased slab segments (sparse tmpfs)
+        <oid>.obj              one-file objects (spill restores, fallback)
+
+The store dirs are tmpfs-backed shared memory: ``shutdown`` removes this
+node's store dir so slab segments and mappings don't outlive the session
+in /dev/shm (stale sessions would otherwise pin host memory until a
+reboot).
 """
 
 from __future__ import annotations
@@ -270,3 +284,14 @@ class NodeProcesses:
                     proc.kill()
                 except Exception:
                     pass
+        # release this node's share of /dev/shm: the store dir (slab
+        # segments, index, .obj files) is dead weight once the raylet is
+        # gone — processes still holding mappings keep their pages until
+        # the views die, so this is safe for stragglers
+        if self.node_id:
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(self.session_dir, f"store_{self.node_id[:12]}"),
+                ignore_errors=True,
+            )

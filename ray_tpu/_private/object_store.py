@@ -2,20 +2,27 @@
 
 The reference's plasma store (ray: src/ray/object_manager/plasma/store.h) is a
 shm arena with create/seal/get/release and LRU eviction; workers map segments
-read-only for zero-copy reads. Here each sealed object is a file in a
-``/dev/shm``-backed session directory mapped with ``mmap``:
+read-only for zero-copy reads. The data plane here has two formats:
 
-  layout:  [8B magic][8B metadata_len][8B data_len][metadata][data]
+- **Slab arena** (default; slab_arena.py): workers lease pre-sized slab
+  segments from their raylet, bump-allocate objects into the mmap'd
+  segment and seal with an atomic header flip; readers resolve
+  ``oid -> (segment, offset)`` through a shared-memory index and return
+  memoryviews straight into the arena. No per-object file, no flock, no
+  per-object syscalls on either side. Accounting is batched: the raylet
+  charges capacity at slab granularity and workers self-report sealed
+  entries asynchronously.
+- **One file per object** (legacy + interop): ``<id>.obj`` files with
+  ``[8B magic][8B metadata_len][8B data_len][metadata][data]``. Still the
+  format for spill/restore and any process without a lease, so mixed
+  clusters and external backends keep working; ``RAY_TPU_slab_arena=0``
+  makes it the only data path again (including the native C++ writer).
 
-Writers create ``<id>.building`` then atomically rename to ``<id>.obj`` on
-seal, so any process on the node can open + mmap a sealed object without
-talking to a broker: the data plane is the kernel page cache, exactly one
-copy per node. Accounting (capacity, pinning, LRU eviction) is done by the
-raylet process that owns the store directory; readers in other processes only
-open/mmap.
-
-A C++ implementation with the same on-disk format can replace the
-writer/accounting path without changing readers.
+Accounting (capacity, pinning, eviction/spill) is done by the raylet
+process that owns the store directory; readers in other processes only
+mmap. Lifetime is segment-granular in the arena: delete flips the entry
+state word (live views keep their pages), and a segment file is unlinked
+only when nothing live remains in it.
 """
 
 from __future__ import annotations
@@ -24,14 +31,20 @@ import mmap
 import os
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, List, Optional
 
+from ray_tpu._private import slab_arena
+from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ObjectID
 
 _MAGIC = b"RTPUOBJ1"
 _HEADER = 24
+
+# negative-cache bound for external-backend probes (see _probe_missed)
+_PROBE_MISSED_MAX = 100_000
 
 # --- runtime metrics (metrics_core.py) ---------------------------------
 # Built lazily; read_object/write_object run in every process (workers
@@ -42,7 +55,8 @@ _MX = None
 
 class _StoreMetrics:
     __slots__ = ("put_lat", "put_bytes", "get_lat", "get_bytes",
-                 "ext_hits", "ext_misses", "spills", "restores")
+                 "ext_hits", "ext_misses", "spills", "restores",
+                 "slab_puts", "file_puts", "overshoot")
 
     def __init__(self):
         from ray_tpu._private import metrics_core as mc
@@ -71,6 +85,16 @@ class _StoreMetrics:
         self.restores = reg.counter(
             "object_store_restores_total",
             "Objects restored from the spill backend").default
+        self.slab_puts = reg.counter(
+            "object_store_slab_puts_total",
+            "Objects sealed into leased slab segments").default
+        self.file_puts = reg.counter(
+            "object_store_file_puts_total",
+            "Objects written as one-file .obj (fallback/interop)").default
+        self.overshoot = reg.counter(
+            "object_store_overshoot_bytes_total",
+            "Bytes admitted past capacity (already-written externals "
+            "and untracked restores)").default
 
 
 def _mx() -> "_StoreMetrics":
@@ -86,13 +110,18 @@ class ObjectStoreFullError(Exception):
 
 @dataclass
 class ObjectBuffer:
-    """A sealed object mapped into this process (zero-copy views)."""
+    """A sealed object mapped into this process (zero-copy views).
+
+    File-backed buffers own their mapping (+flock fd); slab-backed
+    buffers alias the process's shared segment mapping and own nothing —
+    ``release`` is then a no-op and ``seg_id`` names the segment."""
 
     object_id: ObjectID
     metadata: bytes
     data: memoryview
     _mmap: mmap.mmap = None
     _file: object = None
+    seg_id: Optional[int] = None
 
     def release(self):
         if self._mmap is not None:
@@ -105,10 +134,12 @@ class ObjectBuffer:
             except BufferError:
                 # zero-copy slices of the data are still exported (e.g. a
                 # chunk view queued on an rpc frame): the mapping closes
-                # when the last view dies — refcounting, so promptly
+                # when the last view dies, and the weakref.finalize
+                # attached at read time closes the flock fd with it
                 self._mmap = None
                 return
-            self._file.close()
+            if self._file is not None:
+                self._file.close()  # finalize's second close is a no-op
             self._mmap = None
 
 
@@ -117,17 +148,31 @@ def _obj_path(store_dir: str, object_id: ObjectID) -> str:
 
 
 def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
-    """Open and mmap a sealed object. Returns None if absent. Any process.
+    """Resolve + map a sealed object. Returns None if absent. Any process.
 
-    Readers hold a SHARED flock on the file for the buffer's lifetime —
-    the free path's page-recycling pool takes a non-blocking EXCLUSIVE
-    flock before recycling, so pages a live zero-copy view still maps can
-    never be rewritten; the pool falls back to unlink (inode stays intact
-    for existing mappings). The post-lock inode recheck closes the
-    open->lock race against a concurrent pool rename."""
+    Arena first: a shared-index hit validates the in-slab sealed header
+    and returns views into the process's cached segment mapping —
+    flock-free, no per-object syscalls. Legacy ``.obj`` files (spill
+    restores, fallback writes, native-store output) keep the original
+    open+flock path: readers hold a SHARED flock for the buffer's
+    lifetime because the native free path's page-recycling pool takes a
+    non-blocking EXCLUSIVE flock before rewriting pages; slab segments
+    are never rewritten, which is why the arena path needs no lock."""
+    t0 = time.perf_counter()
+    hit = slab_arena.read(store_dir, object_id.binary())
+    if hit is not None:
+        metadata, data, seg_id = hit
+        mx = _mx()
+        mx.get_lat.record(time.perf_counter() - t0)
+        mx.get_bytes.record(data.nbytes)
+        return ObjectBuffer(object_id, metadata, data, seg_id=seg_id)
+    return _read_object_file(store_dir, object_id, t0)
+
+
+def _read_object_file(store_dir: str, object_id: ObjectID,
+                      t0: float) -> Optional[ObjectBuffer]:
     import fcntl
 
-    t0 = time.perf_counter()
     path = _obj_path(store_dir, object_id)
     try:
         f = open(path, "rb")
@@ -142,6 +187,10 @@ def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
         f.close()
         return None
     m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    # the flock fd must outlive every exported view of the mapping, even
+    # when release() can't close the mmap (BufferError): tie the fd's
+    # close to the mapping's own collection
+    weakref.finalize(m, f.close)
     if m[:8] != _MAGIC:
         m.close()
         f.close()
@@ -157,37 +206,35 @@ def read_object(store_dir: str, object_id: ObjectID) -> Optional[ObjectBuffer]:
 
 
 def object_exists(store_dir: str, object_id: ObjectID) -> bool:
-    return os.path.exists(_obj_path(store_dir, object_id))
+    return slab_arena.exists(store_dir, object_id.binary()) \
+        or os.path.exists(_obj_path(store_dir, object_id))
 
 
-def write_object(
-    store_dir: str,
-    object_id: ObjectID,
-    metadata: bytes,
-    buffers: Iterable,
-    total_data_len: int,
-) -> int:
-    """Create + seal an object from buffers. Returns bytes written.
+def discard_local(store_dir: str, object_id: ObjectID) -> bool:
+    """Drop the local copy whatever its backing: mark a slab entry dead
+    (live views keep their pages) or unlink the ``.obj`` file. The
+    test/chaos surface for simulating object loss."""
+    dropped = slab_arena.discard(store_dir, object_id.binary())
+    try:
+        os.unlink(_obj_path(store_dir, object_id))
+        dropped = True
+    except FileNotFoundError:
+        pass
+    return dropped
 
-    Safe from any process; accounting is reconciled by the owning store's
-    directory scan. Writing an already-sealed id is a no-op (objects are
-    immutable, so double-writes are benign).
-    """
+
+def _write_object_file(store_dir: str, object_id: ObjectID, metadata: bytes,
+                       buffers: Iterable, total_data_len: int) -> int:
+    """One-file `.obj` write (no metrics; spill staging + fallback)."""
     final = _obj_path(store_dir, object_id)
     if os.path.exists(final):
         return 0
-    t0 = time.perf_counter()
     from ray_tpu._private import native_store
 
     if native_store.available():
-        written = native_store.write_object(
+        return native_store.write_object(
             store_dir, object_id.hex(), metadata, buffers, total_data_len
         )
-        if written:
-            mx = _mx()
-            mx.put_lat.record(time.perf_counter() - t0)
-            mx.put_bytes.record(total_data_len)
-        return written
     tmp = final + f".building.{os.getpid()}"
     size = _HEADER + len(metadata) + total_data_len
     with open(tmp, "wb") as f:
@@ -198,21 +245,47 @@ def write_object(
         for buf in buffers:
             f.write(buf)
     os.rename(tmp, final)
-    mx = _mx()
-    mx.put_lat.record(time.perf_counter() - t0)
-    mx.put_bytes.record(total_data_len)
     return size
+
+
+def write_object(
+    store_dir: str,
+    object_id: ObjectID,
+    metadata: bytes,
+    buffers: Iterable,
+    total_data_len: int,
+) -> int:
+    """Create + seal a one-file object from buffers. Returns bytes written.
+
+    Safe from any process; accounting is reconciled by the owning store's
+    directory scan. Writing an already-sealed id is a no-op (objects are
+    immutable, so double-writes are benign)."""
+    t0 = time.perf_counter()
+    written = _write_object_file(
+        store_dir, object_id, metadata, buffers, total_data_len
+    )
+    if written:
+        mx = _mx()
+        mx.put_lat.record(time.perf_counter() - t0)
+        mx.put_bytes.record(total_data_len)
+        mx.file_puts.inc()
+    return written
 
 
 def make_local_store(store_dir: str, capacity_bytes: int,
                      spill_dir: Optional[str] = None):
-    """Owner-side store factory: native C++ store (src/librtpu_store.so)
-    when loadable, else the pure-Python implementation. Both share the
-    same on-disk format, so mixed clusters interoperate. ``spill_dir``
-    is a path OR a storage URI (ray: local_object_manager.h:40 +
-    external_storage.py): file:///bare paths spill to disk — the native
-    store's in-C++ fast path; other schemes (s3://, test-registered)
-    route through the Python store's pluggable driver."""
+    """Owner-side store factory. With the slab arena enabled (default)
+    the Python store owns the node's data plane — the arena layout is
+    python-first, and the native C++ writer stays gated behind
+    ``RAY_TPU_slab_arena=0`` until it learns the slab format (the
+    parity gate: both paths serve the same public store surface and the
+    same test suite). Legacy mode picks the native store
+    (src/librtpu_store.so) when loadable. ``spill_dir`` is a path OR a
+    storage URI (ray: local_object_manager.h:40 + external_storage.py):
+    file:///bare paths spill to disk; other schemes (s3://,
+    test-registered) route through the pluggable driver."""
+    if cfg.slab_arena:
+        return LocalObjectStore(store_dir, capacity_bytes, spill_dir)
     from ray_tpu._private import native_store
     from ray_tpu._private.external_storage import is_local_spill_uri
 
@@ -225,20 +298,37 @@ def make_local_store(store_dir: str, capacity_bytes: int,
         return native_store.NativeLocalObjectStore(
             store_dir, capacity_bytes, local
         )
-    return LocalObjectStore(store_dir, capacity_bytes, spill_dir)
+    return LocalObjectStore(store_dir, capacity_bytes, spill_dir,
+                            arena=False)
+
+
+class _Segment:
+    """Owner-side record of one slab segment."""
+
+    __slots__ = ("seg_id", "size", "leased_to", "last_access", "live")
+
+    def __init__(self, seg_id: int, size: int, leased_to: Optional[str]):
+        self.seg_id = seg_id
+        self.size = size  # accounted bytes (full lease, trimmed at seal)
+        self.leased_to = leased_to  # client_id, "_local", or None=sealed
+        self.last_access = time.monotonic()
+        self.live: set = set()  # ObjectIDs resident in this segment
 
 
 class LocalObjectStore:
-    """Owner-side store accounting: capacity, pinning, LRU eviction.
+    """Owner-side store accounting: capacity, pinning, eviction, slabs.
 
     Runs inside the raylet (one per node). Mirrors the reference's
     ObjectLifecycleManager + EvictionPolicy
     (ray: src/ray/object_manager/plasma/object_lifecycle_manager.h:101,
-    eviction_policy.h:160).
+    eviction_policy.h:160), with plasma's arena semantics: capacity is
+    charged at slab-lease granularity, workers self-report sealed
+    entries in batches, and reclamation is whole-segment.
     """
 
     def __init__(self, store_dir: str, capacity_bytes: int,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 arena: Optional[bool] = None):
         self.store_dir = store_dir
         os.makedirs(store_dir, exist_ok=True)
         self.capacity = capacity_bytes
@@ -249,24 +339,356 @@ class LocalObjectStore:
 
         self._external = make_external_storage(spill_dir)
         self._lock = threading.Lock()
-        self._sizes: Dict[ObjectID, int] = {}
+        self._sizes: Dict[ObjectID, int] = {}  # file-backed objects
         self._lru: "OrderedDict[ObjectID, float]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._used = 0
         self._spilled: Dict[ObjectID, int] = {}  # oid -> size on disk
         # restored-from-external objects whose backend copy still exists
         # (cleaned at delete); and oids whose one restart-recovery probe
-        # already missed (never probe the backend again for them)
+        # already missed (never probe the backend again for them) —
+        # bounded FIFO so an overflow evicts the oldest entries instead
+        # of nuking the whole negative cache
         self._ever_spilled: set = set()
-        self._probe_missed: set = set()
+        self._probe_missed: "OrderedDict[ObjectID, None]" = OrderedDict()
         self.spilled_bytes_total = 0
         self.restored_bytes_total = 0
+        self.overshoot_bytes_total = 0
+        # --- slab arena (owner side) ----------------------------------
+        self.arena_enabled = cfg.slab_arena if arena is None else arena
+        self._segments: Dict[int, _Segment] = {}
+        self._slab_objs: Dict[ObjectID, tuple] = {}  # oid -> (seg, off, len)
+        # deletes racing in-flight accounting reports (bounded FIFO —
+        # frees of inline objects the store never saw land here too, and
+        # must not pin memory or evict the cap into uselessness)
+        self._pending_deletes: "OrderedDict[ObjectID, None]" = OrderedDict()
+        self._next_seg = 0
+        # segment recycling pool: all-dead segments parked (renamed) for
+        # lease reuse — a steady put/free cadence writes into warm tmpfs
+        # pages instead of faulting fresh zero pages per slab. Reuse is
+        # gated on an EXCLUSIVE non-blocking flock (readers hold a SHARED
+        # flock per cached segment mapping), so a segment some process
+        # can still see is never rewritten. path -> (file_size, charged);
+        # the charge stays on _used until the entry drains or is reused.
+        self._pool: "OrderedDict[str, tuple]" = OrderedDict()
+        self._pool_seq = 0
+        self._index = None
+        self._local_writer = None
+        if self.arena_enabled:
+            os.makedirs(os.path.join(store_dir, slab_arena.SLAB_DIR),
+                        exist_ok=True)
+            self._index = slab_arena.SharedIndex(
+                slab_arena.index_path(store_dir),
+                slots=cfg.slab_index_slots, create=True,
+            )
+            self._local_writer = slab_arena.SlabWriter(store_dir)
+            with self._lock:
+                self._rescan_segments_locked()
+
+    # -- restart rescan ------------------------------------------------------
+    def _rescan_segments_locked(self):
+        """Adopt whatever a predecessor left in the slab dir: sealed
+        entries become live objects again, torn tails (writer killed
+        mid-put) are discarded by construction (scan stops at the first
+        unsealed entry), and empty segments are unlinked."""
+        slab_dir = os.path.join(self.store_dir, slab_arena.SLAB_DIR)
+        try:
+            names = os.listdir(slab_dir)
+        except OSError:
+            return
+        for name in sorted(names):
+            path = os.path.join(slab_dir, name)
+            seg_id = slab_arena.segment_id_of(path)
+            if seg_id is None:
+                if name.startswith("pool_"):  # predecessor's recycle pool
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                continue
+            self._next_seg = max(self._next_seg, seg_id + 1)
+            seg = _Segment(seg_id, 0, leased_to=None)
+            end = self._reconcile_segment_locked(seg)
+            if not seg.live:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            seg.size = slab_arena.align_up(end)
+            self._segments[seg_id] = seg
+            self._used += seg.size
+
+    # -- slab lease protocol (raylet-facing) ---------------------------------
+    def lease_slab(self, client_id: str, nbytes: int,
+                   seals=None) -> dict:
+        """Grant one pre-sized slab segment to a writer (one RPC
+        amortized over many puts). ``seals`` retires the caller's
+        previous slab(s) in the same round trip."""
+        if not self.arena_enabled:
+            return {"ok": False}
+        if isinstance(seals, dict):
+            seals = [seals]
+        nbytes = slab_arena.align_up(max(1, nbytes))
+        with self._lock:
+            for seal in seals or ():
+                self._seal_segment_locked(
+                    int(seal["seg_id"]), int(seal["used"]), client_id
+                )
+            try:
+                self._ensure_space_locked(nbytes)
+            except ObjectStoreFullError:
+                return {"ok": False}
+            seg_id, actual = self._create_segment_locked(client_id, nbytes)
+        return {"ok": True, "seg_id": seg_id, "size": actual}
+
+    _POOL_MIN_BYTES = 1 << 20  # pooling tiny segments isn't worth the rename
+
+    def _create_segment_locked(self, client_id: str, size: int) -> tuple:
+        """Create (or recycle) one segment; returns (seg_id, actual_size)
+        — a reused pooled file may be larger than asked."""
+        seg_id = self._next_seg
+        self._next_seg += 1
+        reused = self._reuse_pooled_locked(seg_id, size)
+        if reused is None:
+            slab_arena.create_segment(self.store_dir, seg_id, size)
+            self._used += size
+        else:
+            size = reused
+        self._segments[seg_id] = _Segment(seg_id, size, leased_to=client_id)
+        return seg_id, size
+
+    def _reuse_pooled_locked(self, seg_id: int, size: int) -> Optional[int]:
+        """Adopt a pooled segment for a new lease when provably unmapped
+        (exclusive flock) and big enough. Returns its file size, or
+        None."""
+        if not self._pool:
+            return None
+        import fcntl
+
+        # our own reader cache may hold the SHARED flock of a pooled
+        # (path-vanished) segment: release those first
+        slab_arena.view(self.store_dir).sweep()
+        for path, (fsize, charged) in list(self._pool.items()):
+            if fsize < size:
+                continue
+            if self._used + (fsize - charged) > self.capacity:
+                # adopting would re-charge the file's full length past
+                # capacity — the lease's space check only approved
+                # ``size``; an oversized pooled file must not sneak
+                # unaccounted bytes in
+                continue
+            try:
+                fd = os.open(path, os.O_RDWR)
+            except OSError:
+                self._pool.pop(path, None)
+                continue
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                continue  # a reader still maps it: leave it pooled
+            try:
+                os.rename(path, slab_arena.segment_path(self.store_dir,
+                                                        seg_id))
+            except OSError:
+                os.close(fd)
+                self._pool.pop(path, None)
+                continue
+            os.close(fd)  # releases the probe flock
+            self._pool.pop(path, None)
+            self._used += fsize - charged  # re-charge at full file size
+            return fsize
+
+    def _seal_segment_locked(self, seg_id: int, used: int, client_id: str):
+        seg = self._segments.get(seg_id)
+        if seg is None or seg.leased_to != client_id:
+            return
+        # reconcile BEFORE trimming: sealed entries the writer never got
+        # to report (lost notify, kill -9) are recovered from the slab
+        # itself — the accounting protocol is advisory, the arena is
+        # ground truth
+        end = self._reconcile_segment_locked(seg)
+        used = slab_arena.align_up(max(used, end))
+        credit = seg.size - used
+        if credit > 0:
+            self._used -= credit
+            seg.size = used
+        seg.leased_to = None
+        if not seg.live:
+            self._unlink_segment_locked(seg)
+
+    def _reconcile_segment_locked(self, seg: _Segment) -> int:
+        """Scan a segment's sealed prefix into the ledger; returns the
+        scan end offset. Idempotent with worker reports."""
+        end = 0
+        path = slab_arena.segment_path(self.store_dir, seg.seg_id)
+        for oid_b, off, _ml, _dl, total, dead in slab_arena.scan_segment(path):
+            end = off + total
+            if dead:
+                continue
+            oid = ObjectID(oid_b)
+            if oid in self._slab_objs:
+                continue
+            if oid in self._pending_deletes:
+                # the free won the race against the writer's report (or
+                # death): complete the delete — merely skipping would
+                # leave the entry sealed and index-visible forever
+                self._pending_deletes.pop(oid, None)
+                slab_arena.mark_dead_at(self.store_dir, seg.seg_id, off)
+                self._index.mark_dead(oid_b)
+                continue
+            seg.live.add(oid)
+            self._slab_objs[oid] = (seg.seg_id, off, total)
+            self._index.insert(oid_b, seg.seg_id, off)
+        return end
+
+    def record_slab_objects(self, entries: Iterable[dict]) -> List[bytes]:
+        """Batched accounting from writers: adopt reported entries into
+        the ledger. Returns the oids that are NEW to this store (the
+        caller registers their locations with the GCS in one batch)."""
+        new: List[bytes] = []
+        deletes: List[ObjectID] = []
+        with self._lock:
+            for e in entries:
+                oid = ObjectID(bytes(e["o"]))
+                seg = self._segments.get(int(e["s"]))
+                if seg is None:
+                    # segment already reclaimed (straggler report after a
+                    # seal+unlink): the bytes are gone, nothing to adopt
+                    continue
+                if oid in self._slab_objs:
+                    continue
+                off, total = int(e["f"]), int(e["n"])
+                if oid in self._pending_deletes:
+                    # the free won the race: adopt the entry so the
+                    # delete below can mark it dead, never resurrect it
+                    self._pending_deletes.pop(oid, None)
+                    seg.live.add(oid)
+                    self._slab_objs[oid] = (seg.seg_id, off, total)
+                    deletes.append(oid)
+                    continue
+                seg.live.add(oid)
+                seg.last_access = time.monotonic()
+                self._slab_objs[oid] = (seg.seg_id, off, total)
+                self._probe_missed.pop(oid, None)
+                new.append(oid.binary())
+        for oid in deletes:
+            self.delete(oid)
+        return new
+
+    def reclaim_client_slabs(self, client_id: str) -> List[bytes]:
+        """A writer died: adopt the sealed prefix of every slab it still
+        leased (unreported entries included; the torn mid-put tail, if
+        any, is discarded by the scan) and make the segments evictable.
+        Returns newly adopted oids for location registration."""
+        new: List[bytes] = []
+        if not self.arena_enabled:
+            return new
+        with self._lock:
+            for seg in list(self._segments.values()):
+                if seg.leased_to != client_id:
+                    continue
+                before = set(seg.live)
+                end = self._reconcile_segment_locked(seg)
+                new.extend(o.binary() for o in seg.live - before)
+                used = slab_arena.align_up(end)
+                if seg.size > used:
+                    self._used -= seg.size - used
+                    seg.size = used
+                seg.leased_to = None
+                if not seg.live:
+                    self._unlink_segment_locked(seg)
+        return new
+
+    def _unlink_segment_locked(self, seg: _Segment):
+        """Retire an all-dead segment: park big ones in the recycling
+        pool (warm pages for the next lease), unlink the rest."""
+        path = slab_arena.segment_path(self.store_dir, seg.seg_id)
+        self._segments.pop(seg.seg_id, None)
+        pool_cap = max(cfg.slab_size_bytes * 2, self.capacity // 4)
+        pooled_bytes = sum(c for _f, c in self._pool.values())
+        if seg.size >= self._POOL_MIN_BYTES \
+                and pooled_bytes + seg.size <= pool_cap:
+            try:
+                fsize = os.path.getsize(path)  # full length, not the
+                # seal-trimmed accounting size — reuse fits against this
+                slab_arena.wipe_entry_states(path)
+                self._pool_seq += 1
+                pooled = os.path.join(
+                    self.store_dir, slab_arena.SLAB_DIR,
+                    f"pool_{self._pool_seq:08d}.slab",
+                )
+                os.rename(path, pooled)
+                self._pool[pooled] = (fsize, seg.size)  # charge stays
+                return
+            except OSError:
+                pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._used -= seg.size
+
+    def _forget_slab_obj_locked(self, object_id: ObjectID,
+                                mark_dead: bool = True):
+        ent = self._slab_objs.pop(object_id, None)
+        if ent is None:
+            return
+        seg_id, off, _total = ent
+        if mark_dead:
+            slab_arena.mark_dead_at(self.store_dir, seg_id, off)
+            self._index.mark_dead(object_id.binary())
+        seg = self._segments.get(seg_id)
+        if seg is not None:
+            seg.live.discard(object_id)
+            if not seg.live and seg.leased_to is None:
+                self._unlink_segment_locked(seg)
 
     # -- write path ----------------------------------------------------------
-    def put(self, object_id: ObjectID, metadata: bytes, buffers, total_data_len: int):
+    def put(self, object_id: ObjectID, metadata: bytes, buffers,
+            total_data_len: int):
+        """Owner-local put (pull/push receives, broadcasts): bump into the
+        raylet's own slab — the raylet leases from itself, no RPC."""
+        if not self.arena_enabled:
+            return self._put_file(object_id, metadata, buffers,
+                                  total_data_len)
+        with self._lock:
+            if object_id in self._slab_objs or object_id in self._sizes:
+                return  # immutable: double-writes are benign
+        t0 = time.perf_counter()
+        entry_total = slab_arena.entry_size(len(metadata), total_data_len)
+        ent = self._local_writer.try_put(
+            object_id.binary(), metadata, buffers, total_data_len
+        )
+        if ent is None:
+            with self._lock:
+                seal = self._local_writer.take_seal()
+                if seal:
+                    self._seal_segment_locked(
+                        seal["seg_id"], seal["used"], "_local"
+                    )
+                size = max(entry_total,
+                           min(cfg.slab_size_bytes,
+                               max(slab_arena.ALIGN, self.capacity // 8)))
+                self._ensure_space_locked(size)
+                seg_id, size = self._create_segment_locked("_local", size)
+            self._local_writer.attach(seg_id, size)
+            ent = self._local_writer.try_put(
+                object_id.binary(), metadata, buffers, total_data_len
+            )
+        self.record_slab_objects([ent])
+        mx = _mx()
+        mx.put_lat.record(time.perf_counter() - t0)
+        mx.put_bytes.record(total_data_len)
+        mx.slab_puts.inc()
+
+    def _put_file(self, object_id: ObjectID, metadata: bytes, buffers,
+                  total_data_len: int):
         size = _HEADER + len(metadata) + total_data_len
         self._ensure_space(size)
-        written = write_object(self.store_dir, object_id, metadata, buffers, total_data_len)
+        written = write_object(self.store_dir, object_id, metadata, buffers,
+                               total_data_len)
         if written:
             with self._lock:
                 self._sizes[object_id] = written
@@ -274,38 +696,105 @@ class LocalObjectStore:
                 self._lru[object_id] = time.monotonic()
                 # the id exists now: a previously-cached miss must not
                 # mask a later spill-restore of this object
-                self._probe_missed.discard(object_id)
+                self._probe_missed.pop(object_id, None)
 
     def register_external(self, object_id: ObjectID):
-        """Account for an object written directly by a worker process —
-        this is how MOST objects enter the store, so capacity is enforced
-        here too (spilling older objects to make room; the new object is
-        already on shm, so the budget is made around it)."""
+        """Account for a one-file object written directly by another
+        process (lease-less fallback writes, restores) — capacity is
+        enforced here too (spilling older objects to make room; the new
+        object is already on shm, so the budget is made around it)."""
         path = _obj_path(self.store_dir, object_id)
         try:
             size = os.path.getsize(path)
         except FileNotFoundError:
             return
         with self._lock:
-            self._probe_missed.discard(object_id)
+            if object_id in self._pending_deletes:
+                # the owner already freed this object while its
+                # registration was in flight: complete the delete
+                self._pending_deletes.pop(object_id, None)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
+            self._probe_missed.pop(object_id, None)
             if object_id not in self._sizes:
                 try:
                     self._ensure_space_locked(size)
                 except ObjectStoreFullError:
-                    pass  # already written: track the overshoot honestly
+                    # already written: track the overshoot honestly
+                    self._count_overshoot_locked(size)
                 self._sizes[object_id] = size
                 self._used += size
                 self._lru[object_id] = time.monotonic()
 
+    def _count_overshoot_locked(self, size: int):
+        over = min(size, max(0, self._used + size - self.capacity))
+        if over > 0:
+            self.overshoot_bytes_total += over
+            _mx().overshoot.inc(over)
+
     # -- read path -----------------------------------------------------------
+    def _slab_read(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
+        t0 = time.perf_counter()
+        with self._lock:
+            ent = self._slab_objs.get(object_id)
+        if ent is not None:
+            seg_id, off, _total = ent
+            got = slab_arena.read_at(self.store_dir, seg_id, off,
+                                     object_id.binary())
+            if got is not None:
+                metadata, data = got
+                with self._lock:
+                    seg = self._segments.get(seg_id)
+                    if seg is not None:
+                        seg.last_access = time.monotonic()
+                # index repair: a lost insert (slot race) must not force
+                # every reader onto the RPC fallback forever
+                if self._index.lookup(object_id.binary()) is None:
+                    self._index.insert(object_id.binary(), seg_id, off)
+                return self._record_get(
+                    ObjectBuffer(object_id, metadata, data, seg_id=seg_id),
+                    t0,
+                )
+            # discarded/torn behind the ledger: drop the record
+            with self._lock:
+                self._forget_slab_obj_locked(object_id, mark_dead=False)
+            return None
+        # not in the ledger yet (report in flight): the shared index is
+        # the writer's synchronous publication — trust it
+        hit = slab_arena.read(self.store_dir, object_id.binary())
+        if hit is not None:
+            metadata, data, seg_id = hit
+            return self._record_get(
+                ObjectBuffer(object_id, metadata, data, seg_id=seg_id), t0
+            )
+        return None
+
+    @staticmethod
+    def _record_get(buf: ObjectBuffer, t0: float) -> ObjectBuffer:
+        # raylets serve pulls from here: slab reads must show in the
+        # get histograms just like the file path's do
+        mx = _mx()
+        mx.get_lat.record(time.perf_counter() - t0)
+        mx.get_bytes.record(buf.data.nbytes)
+        return buf
+
     def get(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
-        buf = read_object(self.store_dir, object_id)
+        if self.arena_enabled:
+            buf = self._slab_read(object_id)
+            if buf is not None:
+                return buf
+        buf = _read_object_file(self.store_dir, object_id,
+                                time.perf_counter())
         if buf is None and (object_id in self._spilled
                             or self._external is not None):
             # second disjunct = restart recovery: a fresh raylet's ledger
             # doesn't know what its predecessor spilled externally
             if self.restore_if_spilled(object_id):
-                buf = read_object(self.store_dir, object_id)
+                buf = _read_object_file(self.store_dir, object_id,
+                                        time.perf_counter())
         if buf is not None:
             with self._lock:
                 if object_id in self._lru:
@@ -313,7 +802,19 @@ class LocalObjectStore:
         return buf
 
     def contains(self, object_id: ObjectID) -> bool:
-        if object_exists(self.store_dir, object_id) \
+        if self.arena_enabled:
+            with self._lock:
+                ent = self._slab_objs.get(object_id)
+            if ent is not None:
+                state = slab_arena.state_at(self.store_dir, ent[0], ent[1],
+                                            object_id.binary())
+                if state == slab_arena.STATE_SEALED:
+                    return True
+                with self._lock:
+                    self._forget_slab_obj_locked(object_id, mark_dead=False)
+            elif slab_arena.exists(self.store_dir, object_id.binary()):
+                return True  # unreported writer object via the shared index
+        if os.path.exists(_obj_path(self.store_dir, object_id)) \
                 or object_id in self._spilled:
             return True
         if self._external is None or object_id in self._probe_missed:
@@ -330,10 +831,14 @@ class LocalObjectStore:
             # Cleared when the object actually lands here (put /
             # register_external).
             with self._lock:
-                if len(self._probe_missed) > 100_000:
-                    self._probe_missed.clear()
-                self._probe_missed.add(object_id)
+                self._probe_missed_add_locked(object_id)
         return found
+
+    def _probe_missed_add_locked(self, object_id: ObjectID):
+        self._probe_missed[object_id] = None
+        self._probe_missed.move_to_end(object_id)
+        while len(self._probe_missed) > _PROBE_MISSED_MAX:
+            self._probe_missed.popitem(last=False)  # bounded FIFO eviction
 
     # -- spilling (ray: local_object_manager.h SpillObjects/restore) ---------
     @staticmethod
@@ -343,9 +848,9 @@ class LocalObjectStore:
         return object_id.hex() + ".obj"
 
     def _spill_locked(self, object_id: ObjectID) -> bool:
-        """Move one object's file from shm to the external backend; the
-        object stays addressable and is restored on access. Pin counts
-        survive: a spilled primary copy is still the primary copy."""
+        """Move one file-backed object from shm to the external backend;
+        the object stays addressable and is restored on access. Pin
+        counts survive: a spilled primary copy is still the primary."""
         src = _obj_path(self.store_dir, object_id)
         size = self._sizes.get(object_id, 0)
         try:
@@ -361,9 +866,62 @@ class LocalObjectStore:
         _mx().spills.inc()
         return True
 
+    def _spill_slab_object_locked(self, object_id: ObjectID) -> bool:
+        """Stage one slab entry out as a `.obj` file (the spill/interop
+        format) and hand it to the backend; the slab entry is then marked
+        dead. Restore brings it back file-backed."""
+        ent = self._slab_objs.get(object_id)
+        if ent is None:
+            return False
+        seg_id, off, _total = ent
+        got = slab_arena.read_at(self.store_dir, seg_id, off,
+                                 object_id.binary())
+        if got is None:  # discarded behind the ledger
+            self._forget_slab_obj_locked(object_id, mark_dead=False)
+            return False
+        metadata, data = got
+        # stage on DISK, not in the shm store_dir: this runs exactly when
+        # the store is over capacity, and a second tmpfs copy of the
+        # object would consume the resource being reclaimed (backends
+        # only read local_path, so any filesystem works)
+        import tempfile
+
+        staging = os.path.join(tempfile.gettempdir(),
+                               f"rtpu_spill_stage_{os.getpid()}")
+        os.makedirs(staging, exist_ok=True)
+        src = _obj_path(staging, object_id)
+        try:
+            size = _write_object_file(staging, object_id, metadata,
+                                      [data], data.nbytes) \
+                or os.path.getsize(src)
+            self._external.spill(self._spill_key(object_id), src)
+        except Exception:
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
+            return False
+        finally:
+            data.release()
+        try:
+            os.unlink(src)
+        except OSError:
+            pass
+        self._forget_slab_obj_locked(object_id)
+        self._spilled[object_id] = size
+        self.spilled_bytes_total += size
+        _mx().spills.inc()
+        return True
+
+    def _spill_segment_locked(self, seg: _Segment) -> bool:
+        progressed = False
+        for oid in list(seg.live):
+            progressed |= self._spill_slab_object_locked(oid)
+        return progressed
+
     def restore_if_spilled(self, object_id: ObjectID) -> bool:
         """Bring a spilled object back into shm (ray:
-        spilled_object_reader.h — we restore whole objects).
+        spilled_object_reader.h — we restore whole objects, file-backed).
 
         The EXTERNAL copy is deliberately left in place: objects are
         immutable, so with a shared backend (s3) another raylet may
@@ -396,9 +954,7 @@ class LocalObjectStore:
                 ok = False  # backend errors (boto, plugin) degrade to miss
             if not ok:
                 if untracked:
-                    if len(self._probe_missed) > 100_000:
-                        self._probe_missed.clear()
-                    self._probe_missed.add(object_id)
+                    self._probe_missed_add_locked(object_id)
                 return False
             if untracked:
                 # a predecessor raylet spilled this object; its size
@@ -411,7 +967,7 @@ class LocalObjectStore:
                 try:
                     self._ensure_space_locked(size)
                 except ObjectStoreFullError:
-                    pass
+                    self._count_overshoot_locked(size)
             self._spilled.pop(object_id, None)
             self._ever_spilled.add(object_id)
             self._sizes[object_id] = size
@@ -438,11 +994,52 @@ class LocalObjectStore:
         with self._lock:
             self._delete_locked(object_id)
 
-    def _delete_locked(self, object_id: ObjectID):
-        try:
-            os.unlink(_obj_path(self.store_dir, object_id))
-        except FileNotFoundError:
-            pass
+    def delete_many(self, object_ids: Iterable[ObjectID]):
+        """Batched delete: one lock acquisition per free burst (owners
+        tick-batch frees; a 10k-object teardown should not pay 10k lock
+        round trips on the raylet loop)."""
+        with self._lock:
+            for oid in object_ids:
+                self._delete_locked(oid)
+
+    def forget(self, object_id: ObjectID):
+        """Drop a LOST object's records WITHOUT the pending-delete
+        tombstone. A loss report is not a free: lineage reconstruction
+        will re-put this very oid, and a tombstone would kill the fresh
+        copy the moment its accounting report lands."""
+        with self._lock:
+            self._delete_locked(object_id, tombstone=False)
+
+    def _delete_locked(self, object_id: ObjectID, tombstone: bool = True):
+        # This is the raylet's hottest non-data path: owners free EVERY
+        # owned object through it, including inline values the store
+        # never saw — the unknown-oid case must stay a few dict misses.
+        size = self._sizes.pop(object_id, 0)
+        known_file = size > 0
+        if self.arena_enabled:
+            if object_id in self._slab_objs:
+                self._forget_slab_obj_locked(object_id)
+            elif tombstone and not known_file \
+                    and object_id not in self._spilled:
+                # a free can race the writer's in-flight accounting
+                # report: remember it so record_slab_objects completes
+                # the delete instead of resurrecting the object. No
+                # index probe here — frees of inline objects vastly
+                # outnumber real races, and a per-free probe is raylet
+                # CPU stolen from the data path on teardown bursts.
+                self._pending_deletes[object_id] = None
+                while len(self._pending_deletes) > 10_000:
+                    self._pending_deletes.popitem(last=False)
+        # No filesystem touch for oids the ledger doesn't know (the
+        # common case: freed inline/slab objects have no .obj file, and
+        # a stat costs microseconds under a sandboxed kernel). The one
+        # race — a fallback .obj write whose register_put is still in
+        # flight — is closed in register_external via _pending_deletes.
+        if known_file or not self.arena_enabled:
+            try:
+                os.unlink(_obj_path(self.store_dir, object_id))
+            except FileNotFoundError:
+                pass
         was_spilled = self._spilled.pop(object_id, None) is not None
         if (was_spilled or object_id in self._ever_spilled) \
                 and self._external is not None:
@@ -451,7 +1048,6 @@ class LocalObjectStore:
                 self._external.delete(self._spill_key(object_id))
             except Exception:
                 pass  # backend errors must not block the delete
-        size = self._sizes.pop(object_id, 0)
         self._used -= size
         self._lru.pop(object_id, None)
         self._pinned.pop(object_id, None)
@@ -460,8 +1056,15 @@ class LocalObjectStore:
         with self._lock:
             self._ensure_space_locked(size)
 
+    def _fits_locked(self, size: int) -> bool:
+        return self._used + size <= self.capacity
+
     def _ensure_space_locked(self, size: int):
-        if self._used + size <= self.capacity:
+        if self._fits_locked(size):
+            return
+        # recycling pool first: pooled segments are instantly reclaimable
+        self._drain_pool_locked(size)
+        if self._fits_locked(size):
             return
         # SPILL-first when a spill target exists: nothing in this runtime
         # pins primary copies, and deleting the sole copy of a ray.put
@@ -470,21 +1073,55 @@ class LocalObjectStore:
         # (ray: local_object_manager.h:40).
         if self.spill_dir:
             for oid in list(self._lru.keys()):
-                if self._used + size <= self.capacity:
+                if self._fits_locked(size):
                     break
                 self._spill_locked(oid)
+            # then whole segments, coldest first; leased slabs are off
+            # limits (their writers are mid-put in them)
+            for seg in self._sealed_segments_lru_locked():
+                if self._fits_locked(size):
+                    break
+                self._spill_segment_locked(seg)
         # No spill target (or spilling failed): LRU-evict unpinned.
         for oid in list(self._lru.keys()):
-            if self._used + size <= self.capacity:
+            if self._fits_locked(size):
                 break
             if oid in self._pinned:
                 continue
             self._delete_locked(oid)
-        if self._used + size > self.capacity:
+        for seg in self._sealed_segments_lru_locked():
+            if self._fits_locked(size):
+                break
+            if any(oid in self._pinned for oid in seg.live):
+                continue
+            for oid in list(seg.live):
+                self._delete_locked(oid)
+        # segments spilled/evicted above re-park in the pool with their
+        # charge intact — drain again before declaring the store full
+        self._drain_pool_locked(size)
+        if not self._fits_locked(size):
             raise ObjectStoreFullError(
                 f"object of size {size} does not fit: used={self._used} "
-                f"capacity={self.capacity} (all remaining objects pinned)"
+                f"capacity={self.capacity} (remaining objects pinned or "
+                f"in leased slabs)"
             )
+
+    def _drain_pool_locked(self, size: int):
+        """Unlink pooled (all-dead, renamed) segments oldest-first until
+        ``size`` fits; their retained charge comes off _used."""
+        while self._pool and not self._fits_locked(size):
+            path, (_fsize, charged) = self._pool.popitem(last=False)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._used -= charged
+
+    def _sealed_segments_lru_locked(self) -> List[_Segment]:
+        return sorted(
+            (s for s in self._segments.values() if s.leased_to is None),
+            key=lambda s: s.last_access,
+        )
 
     def used_bytes(self) -> int:
         return self._used
@@ -495,8 +1132,12 @@ class LocalObjectStore:
                 "spilled_objects": len(self._spilled),
                 "spilled_bytes_total": self.spilled_bytes_total,
                 "restored_bytes_total": self.restored_bytes_total,
+                "overshoot_bytes_total": self.overshoot_bytes_total,
+                "slab_segments": len(self._segments),
+                "slab_objects": len(self._slab_objs),
             }
 
     def object_ids(self):
         with self._lock:
-            return list(self._sizes.keys()) + list(self._spilled.keys())
+            return list(self._sizes.keys()) + list(self._slab_objs.keys()) \
+                + list(self._spilled.keys())

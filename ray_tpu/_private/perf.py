@@ -51,6 +51,60 @@ def _lat_summary(h) -> dict:
             "p99_us": round(qs[0.99] * 1e6, 1)}
 
 
+def run_object_plane_bench(small: bool = False) -> List[dict]:
+    """Dedicated object-plane lane: put / get latency at 100B, 64KB, 1MB
+    and 64MB (8MB in --small/CI mode) with p50/p95/p99 via the
+    metrics_core histogram path. 100B rides the inline memory store by
+    design; the bulk sizes must be slab-backed (arena data path) — each
+    row carries ``slab_backed`` so CI can gate the structural invariant,
+    not just the throughput."""
+    import ray_tpu  # noqa: F401 (cluster must already be initialized)
+    from ray_tpu._private.worker import global_worker
+
+    cw = global_worker.core_worker
+    big = ("8MB", 8 << 20, 6) if small else ("64MB", 64 << 20, 8)
+    sizes = [
+        ("100B", 100, 200 if small else 1000),
+        ("64KB", 64 * 1024, 100 if small else 400),
+        ("1MB", 1 << 20, 30 if small else 100),
+        big,
+    ]
+    results: List[dict] = []
+    for name, size, iters in sizes:
+        arr = np.arange(size, dtype=np.uint8)
+        hput, hget = _lat_hist(), _lat_hist()
+        slab_backed = False
+        put_s = get_s = 0.0
+        # one warmup op (slab lease, worker pools) outside the clocks
+        ray_tpu.get(ray_tpu.put(arr))
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ref = ray_tpu.put(arr)
+            t1 = time.perf_counter()
+            got = ray_tpu.get(ref)
+            t2 = time.perf_counter()
+            hput.record(t1 - t0)
+            hget.record(t2 - t1)
+            put_s += t1 - t0
+            get_s += t2 - t1
+            buf = cw._pinned_buffers.get(ref.binary())
+            if buf is not None and getattr(buf, "seg_id", None) is not None:
+                slab_backed = True
+            assert got.nbytes == size
+            del ref, got, buf
+        for op, h, secs in (("put", hput, put_s), ("get", hget, get_s)):
+            row = {"benchmark": f"obj {op} {name}",
+                   "value": round(iters / secs, 1) if secs else 0.0,
+                   "unit": "ops/s", "bytes": size,
+                   "slab_backed": slab_backed}
+            row.update(_lat_summary(h))
+            results.append(row)
+            print(f"obj {op} {name:<6s} {row['value']:>12,.1f} ops/s  "  # lint: allow-print
+                  f"p50={row['p50_us']:,.0f}us p95={row['p95_us']:,.0f}us "
+                  f"p99={row['p99_us']:,.0f}us slab={slab_backed}")
+    return results
+
+
 def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
     """Run the suite against an initialized ray_tpu cluster. ``select``
     substring-filters benchmark names; ``small`` shrinks batch sizes (CI)."""

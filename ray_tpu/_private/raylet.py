@@ -1052,7 +1052,10 @@ class Raylet:
                 if not w.registered.done():
                     w.registered.set_result(w)
         return {"node_id": self.node_id, "store_dir": self.store_dir,
-                "resources_total": self.resources_total, "labels": self.labels}
+                "resources_total": self.resources_total, "labels": self.labels,
+                # clients with a lease-capable store use the slab-arena
+                # put path; others fall back to one-file writes
+                "arena": bool(getattr(self.store, "arena_enabled", False))}
 
     def on_disconnect(self, conn: Connection):
         if conn is self.gcs:
@@ -1065,6 +1068,7 @@ class Raylet:
         if kind in ("driver", "worker"):
             cid = conn.meta.get("client_id")
             self.clients.pop(cid, None)
+            self._reclaim_client_slabs(cid)
             if kind == "driver":
                 self._reclaim_client_leases(cid)
             if kind == "worker":
@@ -1190,7 +1194,17 @@ class Raylet:
                 "lease_id": lease_id, "host": self.host,
                 "port": w.direct_port, "worker_id": w.client_id,
             })
-        return {"leases": granted}
+        # spillable: whether routing overflow through the raylet can reach
+        # capacity BEYOND these leases — i.e. LIVE peer nodes exist (the
+        # view retains dead nodes). On a single-node cluster a
+        # constrained grant just means the local workers are the
+        # bottleneck — the driver keeps the queue on its direct
+        # pipelines instead of detouring it through us.
+        peers_alive = sum(
+            1 for nid, n in self.cluster_view.items()
+            if n.alive and nid != self.node_id
+        )
+        return {"leases": granted, "spillable": peers_alive > 0}
 
     def rpc_task_events(self, conn: Connection, p):
         """Events from workers executing direct-push tasks; ride the
@@ -1223,14 +1237,11 @@ class Raylet:
 
     async def _register_stored_objects(self, oids):
         for oid in oids:
+            # slab-resident results are accounted via slab_report; this
+            # charges only one-file fallback writes (no-op otherwise)
             self.store.register_external(ObjectID(oid))
-            try:
-                await self.gcs.request(
-                    "add_object_location",
-                    {"object_id": oid, "node_id": self.node_id},
-                )
-            except Exception:
-                pass
+        if oids:
+            await self._publish_locations(list(oids))
 
     def _release_lease(self, lease_id: str, worker_alive: bool = True):
         lease = self._leases.pop(lease_id, None)
@@ -2066,6 +2077,59 @@ class Raylet:
             pass
         return {}
 
+    # -- slab arena lease + batched accounting (slab_arena.py) ---------
+    async def rpc_lease_slab(self, conn: Connection, p):
+        """Grant a write slab to a local client (one RPC amortized over
+        many puts); ``seal`` retires the caller's previous slab in the
+        same round trip. A denial (no arena / store full of leased
+        slabs) sends the writer to the one-file fallback path, whose
+        register_external accounts the overshoot honestly."""
+        lease = getattr(self.store, "lease_slab", None)
+        if lease is None:
+            return {"ok": False}
+        seals = p.get("seals") or ([p["seal"]] if p.get("seal") else [])
+        return lease(conn.meta.get("client_id") or "", int(p["bytes"]),
+                     seals)
+
+    async def rpc_slab_report(self, conn: Connection, p):
+        """Batched put accounting from a slab writer: adopt the entries
+        into the store ledger and publish the new locations to the GCS
+        in ONE frame (vs the legacy one-register_put-RPC-per-put)."""
+        record = getattr(self.store, "record_slab_objects", None)
+        if record is None:
+            return {}
+        new = record(p["objects"])
+        if new:
+            await self._publish_locations(new)
+            self._dispatch_event.set()
+        return {}
+
+    def _reclaim_client_slabs(self, client_id: str):
+        """A slab-leasing client died: adopt the sealed prefixes of its
+        leased segments (torn mid-put tails are discarded by the scan)
+        and publish any unreported objects it managed to seal."""
+        reclaim = getattr(self.store, "reclaim_client_slabs", None)
+        if reclaim is None or not client_id:
+            return
+        try:
+            new = reclaim(client_id)
+        except Exception:
+            logger.exception("slab reclaim for %s failed", client_id[:8])
+            return
+        if new:
+            t = spawn(self._publish_locations(new))
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+
+    async def _publish_locations(self, oids):
+        try:
+            await self.gcs.request(
+                "add_object_locations",
+                {"object_ids": list(oids), "node_id": self.node_id},
+            )
+        except Exception:
+            pass  # directory is best-effort; owner locations self-heal
+
     async def rpc_pull_object(self, conn: Connection, p):
         owner = p.get("owner")
         ok = await self._ensure_local(
@@ -2530,7 +2594,14 @@ class Raylet:
 
     def rpc_delete_objects(self, conn: Connection, p):
         """Batched GCS free broadcast (one frame per release burst)."""
-        for oid in p["object_ids"]:
+        self._delete_local(p["object_ids"])
+
+    def _delete_local(self, oids):
+        many = getattr(self.store, "delete_many", None)
+        if many is not None:
+            many([ObjectID(oid) for oid in oids])
+            return
+        for oid in oids:
             self.store.delete(ObjectID(oid))
 
     async def rpc_owner_call(self, conn: Connection, p):
@@ -2565,7 +2636,10 @@ class Raylet:
         GCS location so pulls don't chase a dead file
         (ray: object_recovery_manager.h object-loss handling)."""
         oid = p["object_id"]
-        self.store.delete(ObjectID(oid))
+        # forget, not delete: a loss is not a free — reconstruction will
+        # re-put this oid and must not hit a pending-delete tombstone
+        forget = getattr(self.store, "forget", self.store.delete)
+        forget(ObjectID(oid))
         try:
             await self.gcs.request(
                 "remove_object_location",
@@ -2615,11 +2689,10 @@ class Raylet:
         pages to the store's recycling pool NOW instead of after the GCS
         round-trip (a put/free loop would otherwise never see a warm
         pool). The GCS broadcast still clears remote copies."""
-        for oid in p["object_ids"]:
-            try:
-                self.store.delete(ObjectID(oid))
-            except Exception:
-                pass
+        try:
+            self._delete_local(p["object_ids"])
+        except Exception:
+            pass
         try:
             await self.gcs.request(
                 "free_objects", {"object_ids": list(p["object_ids"])}

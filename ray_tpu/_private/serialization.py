@@ -215,7 +215,10 @@ def deserialize(metadata: bytes, data) -> Any:
     for blen in meta["buf_lens"]:
         buffers.append(view[offset : offset + blen])
         offset += blen
-    value = pickle.loads(bytes(pickled), buffers=buffers)
+    # pickle.loads takes any buffer: feed the envelope as a view so a
+    # slab/mmap-backed read never copies the pickle blob either — the
+    # whole deserialize is views into the arena mapping
+    value = pickle.loads(pickled, buffers=buffers)
     if fmt == FMT_ERROR:
         exc, tb, info = value
         raise TaskError(exc, tb, info)

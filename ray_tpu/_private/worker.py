@@ -26,7 +26,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import faultsim, object_store, serialization
+from ray_tpu._private import faultsim, object_store, serialization, slab_arena
 from ray_tpu._private.common import SchedulingStrategy, TaskSpec, rewrite_resources_for_pg
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -177,6 +177,19 @@ class CoreWorker:
         self.node_resources: Dict[str, float] = reply.get("resources_total", {})
         self.node_labels: Dict[str, str] = reply.get("labels", {})
         self.addr = (self.node_id, self.client_id)
+        # slab-arena write path (slab_arena.py): this client leases write
+        # slabs from its raylet and bump-allocates puts/results into the
+        # mmap'd segment; accounting is self-reported in batches
+        self.arena_enabled = bool(reply.get("arena"))
+        self._slab_writer = (
+            slab_arena.SlabWriter(self.store_dir) if self.arena_enabled
+            else None
+        )
+        self._slab_lease_lock = threading.Lock()
+        self._slab_reports: List[dict] = []
+        self._slab_flushing = False
+        self._slab_refill_task = None
+        self._pending_seals: List[dict] = []
         if is_driver:
             self.task_id = TaskID.for_driver(JobID(self.job_id))
         else:
@@ -538,11 +551,23 @@ class CoreWorker:
                                 s, f"task submission failed: {e}"
                             )
                     continue
-                # local leases can't absorb an arbitrarily deep queue; ship
-                # the far tail through the raylet so it can spill to other
-                # nodes instead of starving behind this node's workers
+                # Local leases can't absorb an arbitrarily deep queue —
+                # but detouring the tail through the raylet only helps
+                # when that reaches capacity BEYOND these leases: on a
+                # multi-node cluster (reply.spillable) whose local grant
+                # is the bottleneck — fewer granted than asked, or the
+                # ask itself clamped at direct_lease_max. An unclamped
+                # full grant just means the burst outran the ask (the
+                # submit drain races the lease round trip), and on a
+                # single node the raylet would dispatch to the same
+                # workers via the slow path — either way the queue stays
+                # on the direct pipelines, where feeders amortize via
+                # spec batching and the pump re-leases next iteration.
                 cap = len(leases) * depth * 8
-                if len(q) > cap:
+                local_limit = (len(leases) < want
+                               or want >= cfg.direct_lease_max)
+                if (local_limit and reply.get("spillable")
+                        and len(q) > cap):
                     tail = [q.pop() for _ in range(len(q) - cap)]
                     tail.reverse()
                     try:
@@ -1813,6 +1838,171 @@ class CoreWorker:
         self.io.run(self.gcs.request("publish", {"channel": channel, "message": message}))
 
     # ------------------------------------------------------------------
+    # objects: slab-arena write path (slab_arena.py)
+    # ------------------------------------------------------------------
+    def store_put(self, oid: ObjectID, sv: serialization.SerializedValue):
+        """Store a serialized value (> inline threshold) into the node
+        object plane. Slab arena when this client holds or can lease a
+        write slab: bump-allocate + seal + shared-index publish, with
+        accounting batched to the raylet (no per-put RPC). One-file
+        fallback otherwise — and on the io-loop thread when the slab is
+        full (a refill RPC must never block the loop that sends it);
+        the refill then runs in the background for the next put."""
+        t0 = time.perf_counter()
+        if self._arena_put(oid, sv):
+            mx = object_store._mx()
+            mx.put_lat.record(time.perf_counter() - t0)
+            mx.put_bytes.record(sv.total_data_len)
+            mx.slab_puts.inc()
+            return
+        object_store.write_object(
+            self.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
+        )
+        self._register_put_fallback(oid)
+
+    def _slab_try_put(self, oid: ObjectID,
+                      sv: serialization.SerializedValue) -> bool:
+        ent = self._slab_writer.try_put(
+            oid.binary(), sv.metadata, sv.buffers, sv.total_data_len
+        )
+        if ent is None:
+            return False
+        self._queue_slab_report(ent)
+        return True
+
+    def _arena_put(self, oid: ObjectID,
+                   sv: serialization.SerializedValue) -> bool:
+        if self._slab_writer is None:
+            return False
+        if self._slab_try_put(oid, sv):
+            return True
+        need = slab_arena.entry_size(len(sv.metadata), sv.total_data_len)
+        if threading.current_thread() is self.io.thread:
+            self._kick_slab_refill(need)
+            return False
+        with self._slab_lease_lock:
+            if self._slab_try_put(oid, sv):  # a racing refill already won
+                return True
+            try:
+                ok = self.io.run(self._slab_refill(need),
+                                 timeout=cfg.gcs_rpc_timeout_s * 2)
+            except Exception:
+                ok = False
+            return bool(ok) and self._slab_try_put(oid, sv)
+
+    async def _slab_refill(self, entry_total: int) -> bool:
+        """Serialized refill: at most ONE lease request in flight per
+        client — a second caller (e.g. an io-thread result put racing a
+        user-thread driver put) joins the in-flight refill instead of
+        double-leasing; the loser's attach would otherwise silently
+        detach a just-granted segment with no seal, stranding it leased
+        (and charged) until disconnect."""
+        t = self._slab_refill_task
+        if t is None or t.done():
+            t = asyncio.get_running_loop().create_task(
+                self._do_slab_refill(entry_total)
+            )
+            self._slab_refill_task = t
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+        try:
+            return bool(await asyncio.shield(t))
+        except Exception:
+            return False
+
+    async def _do_slab_refill(self, entry_total: int) -> bool:
+        """Retire the full slab and lease a fresh one (the one lease RPC
+        amortized over every put that lands in it)."""
+        w = self._slab_writer
+        size = w.lease_size_for(entry_total, cfg.slab_size_bytes,
+                                cfg.slab_min_lease_bytes)
+        seal = w.take_seal()
+        seals = ([seal] if seal else []) + self._pending_seals
+        try:
+            r = await self.raylet.request(
+                "lease_slab", {"bytes": size, "seals": seals}
+            )
+        except Exception:
+            # transport failure: the raylet never saw these seals — carry
+            # them all into the next attempt so the segments get retired
+            # (worst case, disconnect reclaim retires them)
+            self._pending_seals = seals[-8:]
+            return False
+        self._pending_seals = []
+        if not r.get("ok"):
+            return False
+        w.attach(r["seg_id"], r["size"])
+        return True
+
+    def _kick_slab_refill(self, entry_total: int):
+        t = self._slab_refill_task
+        if t is not None and not t.done():
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._do_slab_refill(entry_total)
+        )
+        self._slab_refill_task = task
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    def _queue_slab_report(self, ent: dict):
+        """Batched accounting: sealed entries ride one slab_report notify
+        per io-loop burst instead of one registration RPC per put."""
+        with self._lock:
+            self._slab_reports.append(ent)
+            if self._slab_flushing:
+                return
+            self._slab_flushing = True
+        try:
+            self.io.call_soon(self._flush_slab_reports())
+        except RuntimeError:  # loop stopped (shutdown): reconcile recovers
+            with self._lock:
+                self._slab_flushing = False
+
+    async def _flush_slab_reports(self):
+        while True:
+            await asyncio.sleep(0)  # coalesce the current put burst
+            with self._lock:
+                batch, self._slab_reports = self._slab_reports, []
+                if not batch:
+                    self._slab_flushing = False
+                    return
+            try:
+                await self.raylet.notify("slab_report", {"objects": batch})
+            except Exception:
+                # transient raylet unreachability must not strand the
+                # batch (the seal/death reconcile would cover it only at
+                # the NEXT refill or disconnect — an idle writer's
+                # objects would stay invisible to the directory):
+                # requeue bounded and let the next put retrigger a flush
+                with self._lock:
+                    self._slab_reports = (batch + self._slab_reports)[:10_000]
+                    self._slab_flushing = False
+                return
+
+    def _register_put_fallback(self, oid: ObjectID):
+        """Legacy one-file accounting (register_external + location)."""
+        payload = {"object_id": oid.binary()}
+        if threading.current_thread() is self.io.thread:
+            async def _reg():
+                # retried: an unregistered fallback .obj is invisible to
+                # the raylet's accounting/eviction — a dropped frame here
+                # would leak the file until session teardown
+                for delay in (0.0, 0.5, 2.0):
+                    if delay:
+                        await asyncio.sleep(delay)
+                    try:
+                        await self.raylet.request("register_put", payload)
+                        return
+                    except Exception:
+                        continue
+            t = asyncio.get_running_loop().create_task(_reg())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+        else:
+            self.io.run(self.raylet.request("register_put", payload))
+
+    # ------------------------------------------------------------------
     # objects: put/get/wait
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
@@ -1838,10 +2028,9 @@ class CoreWorker:
                 if tokens:
                     self._contains[oid.binary()] = tokens
         else:
-            object_store.write_object(
-                self.store_dir, oid, sv.metadata, sv.buffers, sv.total_data_len
-            )
-            self.io.run(self.raylet.request("register_put", {"object_id": oid.binary()}))
+            # slab-arena write: bump+seal+index, accounting batched — no
+            # blocking per-put registration round trip
+            self.store_put(oid, sv)
             self._record_owned_location(oid.binary(), self.node_id)
             with self._lock:
                 self._owned.add(oid.binary())
@@ -2605,6 +2794,15 @@ class CoreWorker:
                     self.io.run(st["conn"].close(), timeout=2)
             self.io.run(self.raylet.close(), timeout=2)
             self.io.run(self.gcs.close(), timeout=2)
+        except Exception:
+            pass
+        # release this session's arena state (writer slab mapping, cached
+        # reader mappings + flock fds, index mmap) — a long-lived process
+        # cycling init()/shutdown() must not pin dead sessions' shm pages
+        try:
+            if self._slab_writer is not None:
+                self._slab_writer.close()
+            slab_arena.drop_view(self.store_dir)
         except Exception:
             pass
         self.io.stop()

@@ -45,11 +45,10 @@ def test_dynamic_item_lineage_reconstruction(ray_start_regular):
     ref = splitter.remote(3)
     item_refs = ray_tpu.get(ref, timeout=60)
     target = item_refs[1]
-    # locate and delete the backing file on every node dir we know of
+    # drop the backing copy (slab entry or .obj file) behind the runtime
     store_dir = global_worker.core_worker.store_dir
-    path = object_store._obj_path(store_dir, target.id())
-    assert os.path.exists(path), path
-    os.unlink(path)
+    assert object_store.object_exists(store_dir, target.id())
+    assert object_store.discard_local(store_dir, target.id())
     arr = ray_tpu.get(target, timeout=120)
     assert int(arr[0]) == 1 and arr.shape == (64 * 1024,)
 

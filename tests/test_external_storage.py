@@ -76,8 +76,7 @@ def test_externally_spilled_objects_survive_store_restart(tmp_path):
     store = LocalObjectStore(str(tmp_path / "shm1"), 200 * 1024, uri)
     oids = _fill_past_capacity(store)
     spilled = [
-        (oid, payload) for oid, payload in oids
-        if not (tmp_path / "shm1" / (oid.hex() + ".obj")).exists()
+        (oid, payload) for oid, payload in oids if oid in store._spilled
     ]
     assert spilled, "nothing spilled; capacity too large for the test"
 

@@ -65,3 +65,33 @@ def test_object_plane_moves_bulk_bytes(bench_results):
     assert bandwidth >= 50 * (1 << 20), (
         f"object plane at {bandwidth / 1e6:.1f} MB/s", bench_results,
     )
+
+
+@pytest.fixture(scope="module")
+def object_plane_rows(ray_start_regular):
+    from ray_tpu._private.perf import run_object_plane_bench
+
+    return {r["benchmark"]: r for r in run_object_plane_bench(small=True)}
+
+
+def test_object_plane_bulk_is_slab_backed(object_plane_rows):
+    # structural invariant, not a throughput number: >inline-threshold
+    # objects must travel the slab arena (a silent fall-back to one-file
+    # writes would keep working, slowly — this is the canary)
+    for name in ("obj get 1MB", "obj get 8MB"):
+        assert object_plane_rows[name]["slab_backed"], object_plane_rows
+
+
+def test_object_plane_ratio_floors(object_plane_rows):
+    rows = object_plane_rows
+    # arena get is an index hit + memoryview: it must beat the put (which
+    # pays the memcpy) at 1MB, and inline 100B puts must be far cheaper
+    # than 1MB slab puts (floors sit 5-10x under healthy ratios)
+    assert rows["obj get 1MB"]["value"] >= rows["obj put 1MB"]["value"], rows
+    assert rows["obj put 100B"]["value"] >= 3 * rows["obj put 1MB"]["value"], rows
+    # bandwidth floor on the slab path: 1MB roundtrips above the legacy
+    # 50MB/s smoke floor with headroom (structural regressions collapse
+    # this by >10x; box noise does not)
+    rt = 1.0 / (1.0 / rows["obj put 1MB"]["value"]
+                + 1.0 / rows["obj get 1MB"]["value"])
+    assert rt * 2 * (1 << 20) >= 80 * (1 << 20), rows

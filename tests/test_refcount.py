@@ -82,9 +82,9 @@ def test_lineage_reconstruction_on_lost_object(ray_start_regular_fn, tmp_path):
     assert open(marker).read() == "x"
 
     cw = global_worker.core_worker
-    path = object_store._obj_path(cw.store_dir, ref.id())
-    assert os.path.exists(path)
-    os.unlink(path)  # simulate losing the only plasma copy
+    assert object_store.object_exists(cw.store_dir, ref.id())
+    # simulate losing the only plasma copy (slab entry or .obj file)
+    assert object_store.discard_local(cw.store_dir, ref.id())
 
     v2 = ray_tpu.get(ref, timeout=120)
     np.testing.assert_array_equal(v1, v2)
@@ -96,7 +96,7 @@ def test_put_objects_are_not_reconstructable(ray_start_regular_fn):
     v = ray_tpu.get(ref, timeout=60)
     assert v.shape == (1 << 19,)
     cw = global_worker.core_worker
-    os.unlink(object_store._obj_path(cw.store_dir, ref.id()))
+    assert object_store.discard_local(cw.store_dir, ref.id())
     with pytest.raises(Exception):
         ray_tpu.get(ref, timeout=30)
 
