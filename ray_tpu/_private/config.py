@@ -94,6 +94,10 @@ _flag("fetch_warn_timeout_s", float, 10.0)
 _flag("max_concurrent_pulls", int, 8)
 _flag("pull_manager_memory_fraction", float, 0.5)
 _flag("object_spill_dir", str, "")  # path or storage URI (file://, s3://, ...)
+# staging root for mid-spill .obj copies; "" = the spill destination's
+# own filesystem when local, else the system temp dir (often tmpfs —
+# point this at real disk for non-local backends under memory pressure)
+_flag("spill_staging_dir", str, "")
 # module imported by the raylet before building its store — the hook for
 # register_external_storage_scheme plugins (custom spill backends)
 _flag("external_storage_setup_module", str, "")

@@ -63,6 +63,16 @@ class FileSystemStorage(ExternalStorage):
                 fo.write(chunk)
         os.replace(tmp, dst)
 
+    def spill_move(self, key: str, local_path: str) -> bool:
+        """Adopt ``local_path`` as the spilled copy by rename — atomic
+        and copy-free when the caller staged on this filesystem. False
+        (e.g. EXDEV across devices) means fall back to ``spill``."""
+        try:
+            os.replace(local_path, self._path(key))
+            return True
+        except OSError:
+            return False
+
     def restore(self, key: str, local_path: str) -> bool:
         src = self._path(key)
         if not os.path.exists(src):

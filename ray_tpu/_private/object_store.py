@@ -338,6 +338,8 @@ class LocalObjectStore:
         from ray_tpu._private.external_storage import make_external_storage
 
         self._external = make_external_storage(spill_dir)
+        self._spill_staging_root = self._resolve_spill_staging_root()
+        self._sweep_stale_spill_staging()
         self._lock = threading.Lock()
         self._sizes: Dict[ObjectID, int] = {}  # file-backed objects
         self._lru: "OrderedDict[ObjectID, float]" = OrderedDict()
@@ -382,6 +384,10 @@ class LocalObjectStore:
                 slots=cfg.slab_index_slots, create=True,
             )
             self._local_writer = slab_arena.SlabWriter(store_dir)
+            # serializes the put slow path (seal/lease/attach): two
+            # concurrent refills would detach each other's fresh
+            # "_local" segment, stranding its capacity charge
+            self._local_put_lock = threading.Lock()
             with self._lock:
                 self._rescan_segments_locked()
 
@@ -662,20 +668,43 @@ class LocalObjectStore:
             object_id.binary(), metadata, buffers, total_data_len
         )
         if ent is None:
-            with self._lock:
-                seal = self._local_writer.take_seal()
-                if seal:
-                    self._seal_segment_locked(
-                        seal["seg_id"], seal["used"], "_local"
+            # a freshly attached segment can be consumed by the
+            # LOCK-FREE fast path of a concurrent put before our retry
+            # lands, so loop; true capacity exhaustion terminates via
+            # _ensure_space_locked's raise
+            with self._local_put_lock:
+                for _ in range(8):
+                    ent = self._local_writer.try_put(
+                        object_id.binary(), metadata, buffers,
+                        total_data_len
                     )
-                size = max(entry_total,
-                           min(cfg.slab_size_bytes,
-                               max(slab_arena.ALIGN, self.capacity // 8)))
-                self._ensure_space_locked(size)
-                seg_id, size = self._create_segment_locked("_local", size)
-            self._local_writer.attach(seg_id, size)
-            ent = self._local_writer.try_put(
-                object_id.binary(), metadata, buffers, total_data_len
+                    if ent is not None:
+                        break
+                    with self._lock:
+                        seal = self._local_writer.take_seal()
+                        if seal:
+                            self._seal_segment_locked(
+                                seal["seg_id"], seal["used"], "_local"
+                            )
+                        size = max(entry_total,
+                                   min(cfg.slab_size_bytes,
+                                       max(slab_arena.ALIGN,
+                                           self.capacity // 8)))
+                        self._ensure_space_locked(size)
+                        seg_id, size = self._create_segment_locked(
+                            "_local", size)
+                    self._local_writer.attach(seg_id, size)
+                else:
+                    # the loop's last act was an attach: give the fresh
+                    # segment one final try before declaring failure
+                    ent = self._local_writer.try_put(
+                        object_id.binary(), metadata, buffers,
+                        total_data_len
+                    )
+        if ent is None:
+            raise ObjectStoreFullError(
+                f"local slab put of {object_id.hex()} ({entry_total} bytes) "
+                "kept losing freshly attached segments to concurrent puts"
             )
         self.record_slab_objects([ent])
         mx = _mx()
@@ -847,6 +876,64 @@ class LocalObjectStore:
         # id) can restore a predecessor's externally-spilled objects
         return object_id.hex() + ".obj"
 
+    def _resolve_spill_staging_root(self) -> str:
+        """Parent dir for mid-spill ``.obj`` staging. Spilling runs
+        exactly when shm is over capacity, and on many hosts /tmp is
+        itself tmpfs — staging there would double RAM-backed usage per
+        object while memory is the resource being reclaimed. Prefer the
+        spill destination's own filesystem when it is local;
+        ``spill_staging_dir`` overrides, system temp is the last resort
+        (non-local backends with no override)."""
+        import tempfile
+
+        from ray_tpu._private.external_storage import FileSystemStorage
+
+        if cfg.spill_staging_dir:
+            return cfg.spill_staging_dir
+        if isinstance(self._external, FileSystemStorage):
+            return self._external.root
+        return tempfile.gettempdir()
+
+    def _staging_dir_name(self) -> str:
+        # host-qualified: a file:// spill root may be a shared NFS/GCS
+        # mount, and pid liveness is only checkable on the owning host
+        return f"rtpu_spill_stage_{os.uname().nodename}_{os.getpid()}"
+
+    def _sweep_stale_spill_staging(self):
+        """Remove rtpu_spill_stage_<host>_<pid> dirs stranded by a
+        raylet that died mid-spill. Only THIS host's dirs are judged —
+        on a shared spill mount another node's pid space is opaque, and
+        sweeping its live staging would fail its in-flight spills."""
+        import shutil
+
+        try:
+            names = os.listdir(self._spill_staging_root)
+        except OSError:
+            return
+        host = os.uname().nodename
+        for name in names:
+            if not name.startswith("rtpu_spill_stage_"):
+                continue
+            owner, _, pid_s = name[len("rtpu_spill_stage_"):].rpartition("_")
+            try:
+                pid = int(pid_s)
+            except ValueError:
+                continue  # not our naming scheme: leave it
+            if owner != host:
+                continue
+            if pid != os.getpid():
+                try:
+                    os.kill(pid, 0)
+                    continue  # owner still alive — not ours to sweep
+                except ProcessLookupError:
+                    pass
+                except OSError:
+                    continue  # exists under another uid: leave it
+            shutil.rmtree(
+                os.path.join(self._spill_staging_root, name),
+                ignore_errors=True,
+            )
+
     def _spill_locked(self, object_id: ObjectID) -> bool:
         """Move one file-backed object from shm to the external backend;
         the object stays addressable and is restored on access. Pin
@@ -880,38 +967,48 @@ class LocalObjectStore:
             self._forget_slab_obj_locked(object_id, mark_dead=False)
             return False
         metadata, data = got
-        # stage on DISK, not in the shm store_dir: this runs exactly when
-        # the store is over capacity, and a second tmpfs copy of the
-        # object would consume the resource being reclaimed (backends
-        # only read local_path, so any filesystem works)
-        import tempfile
-
-        staging = os.path.join(tempfile.gettempdir(),
-                               f"rtpu_spill_stage_{os.getpid()}")
+        # stage outside the shm store_dir, on the spill destination's
+        # filesystem when local (see _resolve_spill_staging_root):
+        # backends only read local_path, so any filesystem works, but a
+        # tmpfs staging copy would consume the memory being reclaimed
+        staging = os.path.join(self._spill_staging_root,
+                               self._staging_dir_name())
         os.makedirs(staging, exist_ok=True)
         src = _obj_path(staging, object_id)
         try:
             size = _write_object_file(staging, object_id, metadata,
                                       [data], data.nbytes) \
                 or os.path.getsize(src)
-            self._external.spill(self._spill_key(object_id), src)
+            # same-filesystem backends adopt the staged file by rename
+            # (one disk write per object, not two); others copy
+            mover = getattr(self._external, "spill_move", None)
+            if mover is None or not mover(self._spill_key(object_id), src):
+                self._external.spill(self._spill_key(object_id), src)
         except Exception:
-            try:
-                os.unlink(src)
-            except OSError:
-                pass
+            self._drop_staged_locked(staging, src)
             return False
         finally:
             data.release()
-        try:
-            os.unlink(src)
-        except OSError:
-            pass
+        self._drop_staged_locked(staging, src)
         self._forget_slab_obj_locked(object_id)
         self._spilled[object_id] = size
         self.spilled_bytes_total += size
         _mx().spills.inc()
         return True
+
+    @staticmethod
+    def _drop_staged_locked(staging: str, src: str):
+        """Remove a staged spill copy and its per-pid dir (when empty) —
+        a FileSystemStorage backend shares its root with the staging
+        parent, and lingering dirs read as stranded spill state."""
+        try:
+            os.unlink(src)
+        except OSError:
+            pass
+        try:
+            os.rmdir(staging)
+        except OSError:
+            pass  # another spill in flight, or already gone
 
     def _spill_segment_locked(self, seg: _Segment) -> bool:
         progressed = False

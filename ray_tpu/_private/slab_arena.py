@@ -155,7 +155,13 @@ def write_entry(mv: memoryview, off: int, oid: bytes, metadata: bytes,
             buf = buf.cast("B")
         n = buf.nbytes if isinstance(buf, memoryview) else len(buf)
         if fd is not None and n >= PWRITE_MIN:
-            os.pwrite(fd, buf, pos)
+            # pwrite may write fewer bytes than asked (Linux caps a single
+            # call at ~2GiB); loop to completion or the entry seals with
+            # data_len covering a zero-filled tail
+            src = buf if isinstance(buf, memoryview) else memoryview(buf)
+            written = 0
+            while written < n:
+                written += os.pwrite(fd, src[written:], pos + written)
         else:
             mv[pos : pos + n] = buf
         pos += n

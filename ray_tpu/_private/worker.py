@@ -1924,9 +1924,13 @@ class CoreWorker:
             )
         except Exception:
             # transport failure: the raylet never saw these seals — carry
-            # them all into the next attempt so the segments get retired
-            # (worst case, disconnect reclaim retires them)
-            self._pending_seals = seals[-8:]
+            # them ALL into the next attempt so the segments get retired
+            # (worst case, disconnect reclaim retires them). Never drop
+            # any: a dropped seal leaves its segment leased and fully
+            # charged (exempt from eviction) until client disconnect,
+            # and the list grows by at most one tiny dict per failed
+            # refill, so it stays bounded by refill cadence
+            self._pending_seals = seals
             return False
         self._pending_seals = []
         if not r.get("ok"):

@@ -20,6 +20,8 @@ import flax.linen as nn
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ray_tpu.parallel.pipeline import axis_size
+
 
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
@@ -539,7 +541,7 @@ def build_train_step_pp(config: GPT2Config, tx, mesh: Mesh, *,
             # Masking to the LAST pipeline rank pins the head/loss grad
             # path to one rank, so the psum over the pipeline axis below
             # completes replicated-param grads exactly once.
-            is_last = jax.lax.axis_index(axis) == jax.lax.axis_size(axis) - 1
+            is_last = jax.lax.axis_index(axis) == axis_size(axis) - 1
             numer = jax.lax.psum(
                 jnp.where(is_last, -(ll * mask).sum(), 0.0),
                 (axis, batch_axis),

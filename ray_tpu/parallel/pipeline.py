@@ -40,6 +40,16 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
+def axis_size(axis_name: str) -> int:
+    """Size of a mapped axis inside shard_map/pmap, on any jax version
+    (``lax.axis_size`` only exists from 0.4.32; ``psum(1, axis)`` folds
+    to the same constant on older ones)."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:  # jax < 0.4.32
+        return lax.psum(1, axis_name)
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
                    *, axis_name: str = "pipeline"):
     """Apply an S-stage pipeline to M microbatches. Call inside shard_map.
@@ -52,7 +62,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
 
     Returns (M, ...) outputs, replicated across the pipeline axis.
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     M = microbatches.shape[0]
     idx = lax.axis_index(axis_name)
     is_first = idx == 0

@@ -420,3 +420,93 @@ def test_worker_death_reclaims_unreported_objects(tmp_path):
     assert store.contains(oid)
     buf = store.get(oid)
     assert bytes(buf.data) == p
+
+
+# ----------------------------------------------------------------------
+# review fixes: partial pwrite, serialized local refill, spill staging
+# ----------------------------------------------------------------------
+
+def test_write_entry_partial_pwrite_loops_to_completion(tmp_path, monkeypatch):
+    """Linux caps one pwrite at ~2GiB and partial writes are legal in
+    general; write_entry must loop to completion, or a bulk put seals
+    with data_len covering a zero-filled tail (header CRC does not
+    cover data)."""
+    real_pwrite = os.pwrite
+    calls = []
+
+    def short_pwrite(fd, buf, pos):
+        mv = memoryview(buf)[: 64 * 1024]  # kernel-style short write
+        calls.append(mv.nbytes)
+        return real_pwrite(fd, mv, pos)
+
+    monkeypatch.setattr(os, "pwrite", short_pwrite)
+    store_dir = str(tmp_path / "shm")
+    store = LocalObjectStore(store_dir, 1 << 22)
+    r = store.lease_slab("w1", 1 << 21)
+    w = slab_arena.SlabWriter(store_dir)
+    w.attach(r["seg_id"], r["size"])
+    oid = ObjectID.from_random()
+    payload = _payload_for(oid, slab_arena.PWRITE_MIN + 12_345)
+    ent = w.try_put(oid.binary(), b"", [payload], len(payload))
+    assert ent is not None
+    assert len(calls) > 1, "short pwrite was not retried"
+    store.record_slab_objects([ent])
+    buf = store.get(oid)
+    assert bytes(buf.data) == payload, "tail lost to a short pwrite"
+    buf.release()
+    w.close()
+
+
+def test_local_put_failed_retry_raises_not_typeerror(tmp_path, monkeypatch):
+    """If the post-attach retry of the raylet-local put still cannot
+    place the entry, put must raise ObjectStoreFullError explicitly —
+    not hand None to record_slab_objects (TypeError)."""
+    store = LocalObjectStore(str(tmp_path / "shm"), 1 << 22)
+    monkeypatch.setattr(store._local_writer, "try_put",
+                        lambda *a, **k: None)
+    with pytest.raises(object_store.ObjectStoreFullError):
+        store.put(ObjectID.from_random(), b"", [b"x" * 4096], 4096)
+
+
+def test_spill_staging_root_prefers_spill_filesystem(tmp_path):
+    """Over-capacity spilling must not stage the .obj copy on tmpfs
+    (/tmp is tmpfs on many hosts — doubling RAM use while reclaiming
+    RAM): with a local spill backend the staging root is the spill
+    destination's own filesystem."""
+    spill = str(tmp_path / "spill")
+    store = LocalObjectStore(str(tmp_path / "shm"), 4 << 20, spill)
+    assert store._spill_staging_root == spill
+    # force slab objects out: capacity pressure spills to the backend
+    oids = [ObjectID.from_random() for _ in range(4)]
+    for oid in oids:
+        store.put(oid, b"", [_payload_for(oid, 1 << 20)], 1 << 20)
+    big = ObjectID.from_random()
+    store.put(big, b"", [_payload_for(big, 3 << 20)], 3 << 20)
+    stats = store.spilled_stats()
+    assert stats["spilled_objects"] >= 1
+    # staged copies are cleaned up after the backend takes them
+    stage = os.path.join(spill, store._staging_dir_name())
+    assert not os.path.exists(stage) or not os.listdir(stage)
+
+
+def test_stale_spill_staging_swept_on_startup(tmp_path):
+    """rtpu_spill_stage_* dirs stranded by a raylet killed mid-spill are
+    removed when the next store starts on the same staging root."""
+    spill = str(tmp_path / "spill")
+    os.makedirs(spill)
+    child = multiprocessing.Process(target=lambda: None)
+    child.start()
+    child.join()
+    host = os.uname().nodename
+    stale = os.path.join(spill, f"rtpu_spill_stage_{host}_{child.pid}")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "orphan.obj"), "wb") as f:
+        f.write(b"x" * 128)
+    # another HOST's staging on a shared spill mount: pid space is
+    # opaque there, so it must never be swept from here
+    foreign = os.path.join(spill,
+                           f"rtpu_spill_stage_otherhost_{child.pid}")
+    os.makedirs(foreign)
+    LocalObjectStore(str(tmp_path / "shm"), 1 << 20, spill)
+    assert not os.path.exists(stale)
+    assert os.path.exists(foreign)
