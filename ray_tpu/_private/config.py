@@ -204,6 +204,14 @@ _flag("worker_dump_stacks_timeout_s", float, 10.0)
 _flag("gcs_schedule_retry_interval_s", float, 0.2)
 # Per-node dashboard agent (ray: dashboard/agent.py)
 _flag("enable_node_agent", bool, True)
+# Step observatory (steptrace.py): per-step trainer/collective telemetry.
+# steptrace_enabled gates every record path (zero-cost off, same posture
+# as metrics_enabled); the ring holds the newest steptrace_ring_size
+# records per process (a dropped-old-records counter rides the snapshot).
+_flag("steptrace_enabled", bool, True)
+_flag("steptrace_ring_size", int, 8192)
+# per-node fan-out timeout inside steptrace_cluster
+_flag("steptrace_scrape_timeout_s", float, 10.0)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)

@@ -163,6 +163,9 @@ class GcsServer:
         # severity/source/label/message + custom fields), bounded ring
         self.events: deque = deque(maxlen=10_000)
         self._store = make_store(persist_path, cluster_id=cluster_id)
+        # step observatory: rolling collective-skew fold (steptrace.py),
+        # built lazily on the first steptrace_cluster scrape
+        self._steptrace_agg = None
         self._recovering: Set[bytes] = set()  # actor_ids awaiting raylet reclaim
         self._recovered = self._replay()
 
@@ -1101,21 +1104,18 @@ class GcsServer:
 
         return metrics_core.process_snapshot("gcs")
 
-    async def rpc_metrics_cluster(self, conn: Connection, p):
-        """One cluster-wide scrape: fan to every live raylet (which fans
-        to its workers), every registered DRIVER connection (user metrics
-        live in driver processes; workers are already covered through
-        their raylet), plus this GCS — then merge (sum counters/gauges,
-        merge histogram buckets). Mirrors profile_cluster's shape, but
-        cheap enough to poll: one snapshot is a dict copy per process,
-        no sampling window."""
-        from ray_tpu._private import metrics_core
-
-        timeout = cfg.metrics_scrape_timeout_s
+    async def _scrape_processes(self, node_method: str, driver_method: str,
+                                timeout: float, tag_drivers: bool = False):
+        """Shared cluster-scrape fan-out (metrics_cluster and
+        steptrace_cluster differ only in verb names + post-processing):
+        every live raylet's node verb (which fans to its workers) plus
+        every registered DRIVER connection's snapshot verb, gathered
+        concurrently, unreachable targets folded to error dicts. Returns
+        ``(processes, n_nodes)``."""
 
         async def node(nid: str, nconn: Connection):
             try:
-                reply = await nconn.request("metrics_node", {},
+                reply = await nconn.request(node_method, {},
                                             timeout=timeout)
                 return reply.get("processes") or []
             except Exception as e:
@@ -1124,8 +1124,11 @@ class GcsServer:
 
         async def driver(cid: str, cconn: Connection):
             try:
-                return [await cconn.request("metrics_snapshot", {},
-                                            timeout=timeout)]
+                out = await cconn.request(driver_method, {},
+                                          timeout=timeout)
+                if tag_drivers:
+                    out.setdefault("node_id", f"driver:{cid}")
+                return [out]
             except Exception as e:
                 return [{"client_id": cid,
                          "error": f"{type(e).__name__}: {e}"}]
@@ -1142,7 +1145,21 @@ class GcsServer:
             if cconn.meta.get("is_driver") and not cconn.closed:
                 jobs.append(driver(cid, cconn))
         per = await asyncio.gather(*jobs)
-        processes = [proc for plist in per for proc in plist]
+        return [proc for plist in per for proc in plist], n_nodes
+
+    async def rpc_metrics_cluster(self, conn: Connection, p):
+        """One cluster-wide scrape: fan to every live raylet (which fans
+        to its workers), every registered DRIVER connection (user metrics
+        live in driver processes; workers are already covered through
+        their raylet), plus this GCS — then merge (sum counters/gauges,
+        merge histogram buckets). Mirrors profile_cluster's shape, but
+        cheap enough to poll: one snapshot is a dict copy per process,
+        no sampling window."""
+        from ray_tpu._private import metrics_core
+
+        processes, n_nodes = await self._scrape_processes(
+            "metrics_node", "metrics_snapshot",
+            cfg.metrics_scrape_timeout_s)
         processes.append(metrics_core.process_snapshot("gcs"))
         ok = [proc for proc in processes if not proc.get("error")]
         merged = metrics_core.merge_snapshots(
@@ -1154,6 +1171,50 @@ class GcsServer:
             "record_calls": sum(proc.get("record_calls", 0) for proc in ok),
             "errors": [proc for proc in processes if proc.get("error")],
         }
+
+    # ------------------------------------------------------------------
+    # Step observatory (steptrace.py): per-step/per-collective telemetry
+    # fan-out + (group, seq) arrival-skew merge
+    # ------------------------------------------------------------------
+    async def rpc_steptrace_cluster(self, conn: Connection, p):
+        """One cluster-wide step-telemetry scrape: fan to every live
+        raylet (which fans to its workers) plus registered DRIVER
+        connections (a driver can be a collective rank too), then
+
+        1. fold the NEW collective records into the rolling skew metrics
+           (``collective_skew_seconds{rank=}`` histograms + per-rank
+           ``steptrace_straggler_score`` gauge) — they live in THIS
+           process's registry, so they ride the existing /metrics
+           cluster scrape with no extra plumbing;
+        2. join per-rank records by (group, seq) into the merged
+           multi-rank view the train timeline renders.
+
+        Mirrors metrics_cluster's shape; the fold is idempotent across
+        repeated scrapes (per-process record indices high-water-mark)."""
+        from ray_tpu._private import steptrace
+
+        processes, _ = await self._scrape_processes(
+            "steptrace_node", "steptrace_snapshot",
+            cfg.steptrace_scrape_timeout_s, tag_drivers=True)
+        agg = self._steptrace_agg
+        if agg is None:
+            agg = self._steptrace_agg = steptrace.SkewAggregator()
+        # The merge runs over the aggregator's ACCUMULATED log, not just
+        # this scrape: the timeline must survive the workers that
+        # produced it (a trainer's shutdown scrape drains the gang's
+        # rings here right before the actors die). fold + log copy +
+        # merge are all CPU-bound python over up to log_limit records —
+        # the whole thing runs on an executor thread (the aggregator is
+        # internally locked) so a full log never stalls the GCS event
+        # loop; ?limit caps the merge to the newest N records for cheap
+        # polling surfaces.
+        merged = await asyncio.get_running_loop().run_in_executor(
+            None, agg.fold_and_merge, processes,
+            (p or {}).get("limit") or 0)
+        merged["processes"] = len(processes)
+        merged["errors"] = [proc for proc in processes
+                            if proc.get("error")]
+        return merged
 
     # ------------------------------------------------------------------
     # Task events (observability; ray: gcs_task_manager.h)

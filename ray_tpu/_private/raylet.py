@@ -102,6 +102,9 @@ class _Worker:
         # fallback prefix name for lines outside any task span (set to
         # the actor class once this worker becomes an actor)
         self.log_name: Optional[str] = None
+        # unattributed lines held for ONE tail tick so a racing RUNNING
+        # event can land and win attribution over the fallback prefix
+        self.log_held: list = []  # [(absolute_offset, raw_line), ...]
 
     def kill_process(self):
         """Kill the worker AND its container, if any: a plain kill only
@@ -165,21 +168,44 @@ def _tail_worker_log(w: _Worker, final: bool = False):
     if final and w.log_partial:
         lines_out.append((pos, w.log_partial))
         w.log_partial = b""
+    # One-tick hold for unattributed actor lines (closes the PR 7
+    # cosmetic race): a line printed before its task's RUNNING event
+    # reached this raylet used to take the actor-class fallback prefix
+    # (w.log_name) immediately. Fresh lines that resolve to no span on a
+    # worker that HAS a fallback name are instead carried to the next
+    # tick — by then the event has almost always landed and the method-
+    # name prefix wins. Order-preserving (everything after the first held
+    # line holds with it); carried lines always publish on their second
+    # look (resolved, or the class fallback for genuinely task-less
+    # output), so the delay is bounded at one log_tail_interval_s.
+    # Workers with no fallback name keep publishing immediately — there
+    # is no wrong prefix to race against.
+    held = getattr(w, "log_held", None) or []
+    w.log_held = []
+    n_held = len(held)
+    all_lines = held + lines_out
     segs: list = []  # [[task_name_or_None, [text...]], ...]
-    for off, raw in lines_out:
+    for i, (off, raw) in enumerate(all_lines):
         if not raw:
             continue
+        name = w.log_spans.resolve(off)
+        if name is None and not final and i >= n_held \
+                and w.log_name is not None:
+            w.log_held = [ln for ln in all_lines[i:] if ln[1]]
+            break
+        name = name or w.log_name
         raw, truncated = logplane.truncate_line(raw, cfg.log_max_line_bytes)
         stats["truncated"] += truncated
         stats["lines"] += 1
         stats["bytes"] += len(raw)
-        name = w.log_spans.resolve(off) or w.log_name
         text = raw.decode("utf-8", "replace")
         if segs and segs[-1][0] == name:
             segs[-1][1].append(text)
         else:
             segs.append([name, [text]])
-    w.log_spans.prune(w.log_offset - len(w.log_partial))
+    # never prune spans still ahead of a held line's second look
+    w.log_spans.prune(w.log_held[0][0] if w.log_held
+                      else w.log_offset - len(w.log_partial))
     if not segs:
         return None, stats
     return {
@@ -2827,6 +2853,31 @@ class Raylet:
         processes = list(await asyncio.gather(*[one(w) for w in live]))
         processes.append(metrics_core.process_snapshot(
             "raylet", {"node_id": self.node_id}))
+        return {"node_id": self.node_id, "processes": processes}
+
+    # -- step observatory (steptrace.py) -------------------------------
+    async def rpc_steptrace_node(self, conn: Connection, p):
+        """Every live worker's steptrace ring, gathered CONCURRENTLY
+        (same posture as metrics_node: one wedged worker must not stall
+        the scrape). The raylet itself runs no collectives or train
+        steps, so it contributes no snapshot of its own."""
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+
+        async def one(w: _Worker):
+            try:
+                out = await w.conn.request(
+                    "steptrace_snapshot", {},
+                    timeout=cfg.steptrace_scrape_timeout_s)
+            except Exception as e:
+                return {"pid": w.proc.pid, "node_id": self.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+            out.setdefault("node_id", self.node_id)
+            return out
+
+        processes = list(await asyncio.gather(*[one(w) for w in live]))
         return {"node_id": self.node_id, "processes": processes}
 
     # ------------------------------------------------------------------

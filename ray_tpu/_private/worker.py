@@ -1575,6 +1575,15 @@ class CoreWorker:
         return self._annotate_profile(metrics_core.process_snapshot(
             "driver" if self.is_driver else "worker"))
 
+    # -- step observatory (steptrace.py) -------------------------------
+    async def rpc_steptrace_snapshot(self, conn: Connection, p):
+        """This process's step-telemetry ring (collective ops, step
+        phases, compile events) — the GCS-side merge joins these across
+        ranks by (group, seq) into arrival-skew attribution."""
+        from ray_tpu._private import steptrace
+
+        return self._annotate_profile(steptrace.process_snapshot())
+
     async def rpc_pubsub(self, conn: Connection, p):
         self._dispatch_pubsub(p["channel"], p["message"])
 

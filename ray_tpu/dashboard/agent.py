@@ -13,6 +13,8 @@ Routes:
       window (raylet + its workers; see _private/profiler.py)
   GET /metrics        — node-local Prometheus scrape (raylet + workers,
       merged; also at /api/v0/metrics, ?format=json for raw snapshots)
+  GET /api/v0/steptrace — node-local step-observatory rings (this
+      raylet's workers; cross-rank skew merges at the GCS)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
   GET /api/v0/logs/range?file=<name>&start=A&end=B — exact byte range
@@ -155,6 +157,14 @@ class Agent:
         return web.Response(text=text, content_type="text/plain",
                             charset="utf-8")
 
+    async def steptrace(self, request):
+        """Node-local step-observatory snapshot: this raylet's workers'
+        telemetry rings (collective ops, step phases, compile events) —
+        the per-node analog of the head's /api/v0/train. Cross-rank skew
+        needs the GCS merge; this surface is for poking one node."""
+        conn = await self._raylet()
+        return _json(await conn.request("steptrace_node", {}, timeout=30))
+
     async def logs(self, request):
         log_dir = os.path.join(self.session_dir, "logs")
         out = []
@@ -233,6 +243,7 @@ async def amain(args) -> None:
     app.router.add_get("/api/v0/profile", agent.profile)
     app.router.add_get("/metrics", agent.metrics)
     app.router.add_get("/api/v0/metrics", agent.metrics)
+    app.router.add_get("/api/v0/steptrace", agent.steptrace)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
     app.router.add_get("/api/v0/logs/range", agent.range)

@@ -90,6 +90,33 @@ def _build_app():
         )
         return _json_response(out)
 
+    @routes.get("/api/v0/train")
+    async def train_summary(request):
+        """Step observatory summary for the Train tab: merged collectives
+        with skew attribution, per-rank straggler scores, step phases,
+        compile events (one steptrace_cluster scrape). This is a POLLING
+        surface (5s SPA auto-refresh rendering only the top slices), so
+        the merge is capped to the newest records by default; ?limit=0
+        uncaps it."""
+        try:
+            limit = int(request.query.get("limit", "20000"))
+        except ValueError:
+            return _json_response({"error": "limit must be an integer"},
+                                  status=400)
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.steptrace_summary(limit=limit or None)
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/train_timeline")
+    async def train_timeline(request):
+        """Merged multi-rank step timeline as Chrome-trace JSON
+        (Perfetto-loadable; what `ray_tpu train timeline` writes)."""
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.train_timeline(None)
+        )
+        return _json_response(out)
+
     @routes.get("/api/v0/metrics")
     async def metrics(request):
         from ray_tpu.util import metrics as m

@@ -473,6 +473,47 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_train_timeline(args):
+    """Step observatory export: one cluster scrape of the per-rank
+    steptrace rings, merged by (group, seq), written as Chrome-trace /
+    Perfetto JSON, with the per-rank straggler attribution printed as a
+    table (score = rolling EWMA of 'arrived last to a collective')."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    try:
+        merged = state.steptrace_summary()
+        from ray_tpu._private import steptrace
+
+        trace = steptrace.chrome_trace(merged)
+        path = args.output or f"ray-tpu-train-timeline-{int(time.time())}.json"
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        colls = merged.get("collectives", ())
+        print(f"wrote {len(trace)} trace events to {path} "
+              f"({len(colls)} collectives, "
+              f"{len(merged.get('steps', ()))} steps, "
+              f"{len(merged.get('compiles', ()))} compiles)")
+        scores = merged.get("straggler_scores") or {}
+        if scores:
+            print("per-rank straggler score (EWMA of 'arrived last'; "
+                  f"~{1.0 / max(len(scores), 1):.2f} is uniform):")
+            for rank, score in sorted(scores.items(),
+                                      key=lambda kv: -kv[1]):
+                print(f"  rank {rank:>3s}  {score:.3f}")
+        worst = [c for c in colls if c.get("skew", 0) > 0]
+        worst.sort(key=lambda c: -c["skew"])
+        for c in worst[: args.top]:
+            print(f"  skew {c['skew'] * 1e3:8.3f}ms  {c['group']}#{c['seq']} "
+                  f"{c['op']} last=rank{c['last_rank']}"
+                  + (f" missing={c['missing']}" if c["missing"] else ""))
+        for err in merged.get("errors", ()):
+            print(f"! unreachable: {err}", file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_list(args):
     """ray parity: `ray list tasks|actors|nodes|objects|placement-groups|
     jobs` (util/state CLI)."""
@@ -702,6 +743,24 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("-o", "--output")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "train",
+        help="step observatory: per-step trainer/collective telemetry",
+    )
+    tsub = p.add_subparsers(dest="train_command", required=True)
+    tp = tsub.add_parser(
+        "timeline",
+        help="merged multi-rank step timeline (Perfetto JSON) + per-rank "
+             "straggler attribution",
+    )
+    tp.add_argument("-o", "--output",
+                    help="output path (default ray-tpu-train-timeline-"
+                         "<ts>.json)")
+    tp.add_argument("--top", type=int, default=10,
+                    help="worst-skew collectives to print (default 10)")
+    tp.add_argument("--address")
+    tp.set_defaults(fn=cmd_train_timeline)
 
     p = sub.add_parser("list", help="list cluster state resources")
     p.add_argument("resource", choices=[

@@ -10,7 +10,7 @@ from ray_tpu.train.backend import (Backend, BackendConfig, JaxConfig,
                                    TensorflowConfig, TorchConfig)
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report)
+                                   get_dataset_shard, report, step_phase)
 from ray_tpu.train.trainer import (
     BaseTrainer,
     DataParallelTrainer,
@@ -55,6 +55,7 @@ __all__ = [
     "get_dataset_shard",
     "get_context",
     "report",
+    "step_phase",
     "TransformersTrainer",
 ]
 

@@ -205,6 +205,22 @@ class BackendExecutor:
             self.backend.on_shutdown(self.worker_group, self.backend_config)
         except Exception:
             pass
+        # Drain the gang's step-telemetry rings into the GCS aggregator
+        # BEFORE killing the workers: the merged train timeline
+        # (`ray_tpu train timeline`, util.state.train_timeline) must
+        # outlive the run. Best-effort — an unreachable GCS or a
+        # disabled steptrace plane costs nothing here.
+        if self.worker_group and self.worker_group.workers:
+            try:
+                from ray_tpu._private import steptrace
+                from ray_tpu.util import state
+
+                if steptrace.is_enabled():
+                    # limit=1: the fold (ring drain) is the point — skip
+                    # building + shipping the full merged timeline here
+                    state.steptrace_summary(limit=1)
+            except Exception:
+                pass
         if self.worker_group:
             self.worker_group.shutdown()
         if self.pg is not None:

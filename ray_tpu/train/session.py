@@ -4,6 +4,14 @@ ray parity: python/ray/train/_internal/session.py:84 (_TrainSession),
 air/session.py (report/get_checkpoint/get_context). Inside a train worker the
 user loop calls ``report(metrics, checkpoint=...)``; results flow through a
 queue polled by the BackendExecutor on the driver.
+
+Step observatory hooks (_private/steptrace.py): ``init_session`` stamps
+the worker's rank/world onto the process steptrace context,
+``step_phase("data"|"h2d"|"compute"|"optimizer")`` records intra-step
+phase intervals, and every ``report()`` auto-delimits a step boundary —
+so a multi-rank trainer gets a merged per-step timeline
+(``util.state.train_timeline()``) without any explicit instrumentation
+beyond its existing report loop.
 """
 
 from __future__ import annotations
@@ -12,6 +20,7 @@ import queue
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import steptrace
 from ray_tpu.air.checkpoint import Checkpoint
 
 
@@ -75,6 +84,12 @@ def init_session(ctx: TrainContext, loaded_checkpoint: Optional[Checkpoint]) -> 
     global _session
     with _lock:
         _session = _Session(ctx, loaded_checkpoint)
+    # steptrace records (phases, step boundaries, compiles) carry this
+    # worker's rank from here on; step 0 starts now. The jax.monitoring
+    # listener mirrors backend compile events into the ring so compile
+    # storms show up even for jitted fns nobody wrapped in trace_jit.
+    steptrace.set_train_context(ctx.get_world_rank(), ctx.get_world_size())
+    steptrace.install_compile_listener()
     return _session
 
 
@@ -82,6 +97,7 @@ def shutdown_session():
     global _session
     with _lock:
         _session = None
+    steptrace.clear_train_context()
 
 
 def get_session() -> Optional[_Session]:
@@ -95,6 +111,9 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
     s = _session
     if s is None:
         return metrics
+    # step observatory: a report IS the natural step boundary — close the
+    # current step interval and open the next (steptrace no-ops when off)
+    steptrace.step_mark()
     payload = {"type": "report", "metrics": dict(metrics)}
     if checkpoint is not None:
         # Materialize to a directory so the driver (possibly another node)
@@ -106,6 +125,24 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
     s.queue.put(payload)
     if s.stop_requested.is_set():
         raise SystemExit("training stop requested")
+
+
+def step_phase(name: str):
+    """Context manager delimiting one phase of the current training step
+    — canonical phases are ``"data"`` (host-side batch prep), ``"h2d"``
+    (host-to-device transfer), ``"compute"`` (the jitted step), and
+    ``"optimizer"`` (update/apply); free-form names render too. Records
+    into the step observatory ring (zero-cost when steptrace is
+    disabled); the merged multi-rank view comes back through
+    ``util.state.train_timeline()`` / ``ray_tpu train timeline``::
+
+        with train.step_phase("data"):
+            batch = next(it)
+        with train.step_phase("compute"):
+            params, opt_state, loss = step(params, opt_state, batch)
+        train.report({"loss": float(loss)})   # step boundary
+    """
+    return steptrace.phase(name)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

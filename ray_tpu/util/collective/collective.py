@@ -21,6 +21,20 @@ Out-of-graph ops here are for control-plane-sized data (weight broadcast,
 metric reduction); inner-loop gradient reduction should use the in-graph
 path (ray_tpu.parallel / trainers), exactly as NCCL-allreduce lives inside
 torch DDP in the reference.
+
+Telemetry: every op (allreduce/allgather/reducescatter/broadcast/barrier)
+consumes one per-group monotonic sequence number and records a steptrace
+event (rank-local start/end/bytes keyed by (group, seq) — see
+_private/steptrace.py) so a GCS-side merge can attribute per-collective
+arrival skew to the rank that showed up last. With RAY_TPU_TRACING=1 each
+op additionally emits a tracing span, interleaving with task spans in
+``state.timeline()``.
+
+CPU portability: when the runtime cannot execute multiprocess XLA
+computations (CPU backend raises "Multiprocess computations aren't
+implemented"), the xla backend transparently falls back to the native
+``_phase`` KV-rendezvous ring path — the API surface (and its steptrace
+records) works everywhere; only the transport differs.
 """
 
 from __future__ import annotations
@@ -32,6 +46,9 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from ray_tpu._private import steptrace
+from ray_tpu.util import tracing
 
 _KV_NS = b"collective"
 
@@ -59,7 +76,10 @@ class _Group:
     world_size: int
     rank: int
     backend: str
-    seq: int = 0
+    seq: int = 0  # per-group monotonic op counter (the steptrace join key)
+    # sticky: the xla transport proved unavailable (CPU multiprocess);
+    # ops route through the _phase ring path from then on
+    xla_fallback: bool = False
     p2p_send: Dict[int, int] = None  # per-destination send counters
     p2p_recv: Dict[int, int] = None  # per-source recv counters
     mesh: object = None  # xla backend: 1-device-per-rank Mesh over axis "ranks"
@@ -69,6 +89,14 @@ class _Group:
         self.p2p_send = {}
         self.p2p_recv = {}
         self._compiled = {}
+
+    def alloc_seq(self) -> int:
+        """Consume the next per-group sequence number (wraps at
+        steptrace.SEQ_MOD; all ranks wrap at the same count, so the
+        (group, seq) join key stays aligned)."""
+        seq = self.seq
+        self.seq = (self.seq + 1) % steptrace.SEQ_MOD
+        return seq
 
 
 _groups: Dict[str, _Group] = {}
@@ -231,15 +259,19 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
-def _phase(g: _Group, op: str, timeout: float, payload: bytes) -> List[bytes]:
+def _phase(g: _Group, op: str, timeout: float, payload: bytes,
+           seq: Optional[int] = None) -> List[bytes]:
     """All ranks contribute payload; returns all contributions rank-ordered.
 
     KV-barrier rendezvous keyed by (group, seq, op). The GCS KV plays the
     role of the reference's rendezvous store (ray: util/collective/
     collective_group/nccl_util.py store-based unique-id exchange).
+    ``seq`` is the op's already-allocated group sequence number (every
+    public op allocates one up front so steptrace records and rendezvous
+    keys agree); direct callers may omit it.
     """
-    seq = g.seq
-    g.seq += 1
+    if seq is None:
+        seq = g.alloc_seq()
     base = f"{g.name}:{seq}:{op}".encode()
     _kv_put(base + f":{g.rank}".encode(), payload)
     outs = []
@@ -249,6 +281,31 @@ def _phase(g: _Group, op: str, timeout: float, payload: bytes) -> List[bytes]:
     if g.rank == 0 and seq > 0:
         _kv_del_prefix(f"{g.name}:{seq - 1}:".encode())
     return outs
+
+
+def _op(g: _Group, op: str, nbytes: int, call):
+    """Run one collective op under telemetry: allocate the per-group seq,
+    time the rank-local interval into the steptrace ring, and (with
+    tracing enabled) wrap it in a span so it interleaves with task spans
+    in state.timeline(). ``call(seq)`` performs the actual transport.
+
+    The record lands in a ``finally``: a rank that RAISES (rendezvous
+    timeout because a peer never arrived — the straggler failure this
+    plane exists to diagnose) still records its arrival time and how
+    long it waited, so the GCS merge shows the (group, seq) row with the
+    wedged rank in ``missing`` instead of showing nothing at all."""
+    seq = g.alloc_seq()
+    start = time.time()
+    try:
+        if tracing.is_enabled():
+            with tracing.span(f"collective.{op}", group=g.name, seq=seq,
+                              rank=g.rank, world=g.world_size,
+                              bytes=nbytes):
+                return call(seq)
+        return call(seq)
+    finally:
+        steptrace.record_collective(g.name, seq, op, g.rank, g.world_size,
+                                    start, time.time(), nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -268,7 +325,10 @@ def _xla_compiled(g: _Group, op: str, arr: "np.ndarray", extra=()):
 
     Every rank's contribution is one shard of a (world, *shape) global array
     over the "ranks" mesh axis; the body runs the XLA collective so the
-    partitioner lowers it onto ICI rings.
+    partitioner lowers it onto ICI rings. Returns ``(fn, fresh)`` —
+    ``fresh`` means this (op, shape, dtype) was not cached, so the first
+    execution will pay trace+compile (recorded as a steptrace compile
+    event by the caller; a shape/dtype churn storm shows up per op).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -281,7 +341,7 @@ def _xla_compiled(g: _Group, op: str, arr: "np.ndarray", extra=()):
     key = (op, arr.shape, str(arr.dtype), tuple(extra))
     fn = g._compiled.get(key)
     if fn is not None:
-        return fn
+        return fn, False
     mesh = g.mesh
     in_spec = P("ranks")
 
@@ -329,7 +389,7 @@ def _xla_compiled(g: _Group, op: str, arr: "np.ndarray", extra=()):
         out_shardings=NamedSharding(mesh, out_spec),
     )
     g._compiled[key] = fn
-    return fn
+    return fn, True
 
 
 def _xla_global_input(g: _Group, arr: "np.ndarray"):
@@ -352,9 +412,72 @@ def _xla_local_out(out) -> "np.ndarray":
     return np.asarray(shard.data)
 
 
-def _xla_collective(g: _Group, op: str, arr: "np.ndarray", extra=()):
-    fn = _xla_compiled(g, op, arr, extra)
-    return _xla_local_out(fn(_xla_global_input(g, arr)))
+def _xla_unavailable(e: BaseException) -> bool:
+    """The one failure we transparently degrade on: the backend cannot
+    RUN multiprocess computations at all (CPU: "Multiprocess computations
+    aren't implemented"). Anything else propagates — a real compile or
+    shape error must not silently change transport."""
+    return "multiprocess computation" in str(e).lower()
+
+
+def _store_xla_equivalent(g: _Group, op: str, arr: "np.ndarray",
+                          timeout: float, seq: Optional[int], extra=()):
+    """Run the xla op's semantics over the native ``_phase`` ring path,
+    returning exactly the shape the xla program would have produced for
+    this rank (psum* -> reduced full array; allgather -> (world, *shape);
+    reducescatter -> this rank's shard; broadcast -> src's array)."""
+    if op == "broadcast":
+        # only src's payload is ever read: non-src ranks contribute an
+        # empty marker (same cheap form as the native broadcast path) —
+        # world x full-tensor KV traffic for a one-way op is waste
+        (src,) = extra
+        payload = pickle.dumps(arr, protocol=5) if g.rank == src else b""
+        outs = _phase(g, "x" + op, timeout, payload, seq=seq)
+        return pickle.loads(outs[src])
+    outs = _phase(g, "x" + op, timeout, pickle.dumps(arr, protocol=5),
+                  seq=seq)
+    stacked = np.stack([pickle.loads(o) for o in outs])
+    if op == "psum":
+        return stacked.sum(axis=0)
+    if op == "pmean":
+        return stacked.mean(axis=0)
+    if op == "pmax":
+        return stacked.max(axis=0)
+    if op == "pmin":
+        return stacked.min(axis=0)
+    if op == "allgather":
+        return stacked
+    if op == "reducescatter":
+        return np.split(stacked.sum(axis=0), g.world_size, axis=0)[g.rank]
+    raise ValueError(op)  # pragma: no cover
+
+
+def _xla_collective(g: _Group, op: str, arr: "np.ndarray", extra=(),
+                    timeout: float = 120.0, seq: Optional[int] = None):
+    if not g.xla_fallback:
+        try:
+            # "first call" = this group's first program at all; a fresh
+            # (op, shape, dtype) on a warm group is a RECOMPILE — shape
+            # churn must render as the storm it is, not as benign firsts
+            had_programs = bool(g._compiled)
+            fn, fresh = _xla_compiled(g, op, arr, extra)
+            t0 = time.time()
+            out = _xla_local_out(fn(_xla_global_input(g, arr)))
+            if fresh:
+                # jit compiles lazily: a cache-miss call's wall time IS
+                # trace+compile(+run) — attribute it per collective op
+                steptrace.record_compile(f"collective.{op}", t0,
+                                         time.time(),
+                                         first=not had_programs)
+            return out
+        except Exception as e:
+            if not _xla_unavailable(e):
+                raise
+            # Sticky per group: every rank hits the identical backend
+            # limitation on its first op, so all ranks degrade at the
+            # same seq and the _phase rendezvous keys line up.
+            g.xla_fallback = True
+    return _store_xla_equivalent(g, op, arr, timeout, seq, extra)
 
 
 def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
@@ -364,16 +487,20 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
     inputs are also updated in place for drop-in parity)."""
     g = _group(group_name)
     arr = _to_numpy(tensor)
-    if g.backend == "xla":
-        if op == ReduceOp.PRODUCT:  # no pprod primitive: gather + local prod
-            gathered = _xla_collective(g, "allgather", arr)
-            result = np.prod(gathered, axis=0)
-        else:
-            result = _xla_collective(g, _XLA_REDUCE[op], arr)
-    else:
-        outs = _phase(g, "ar", timeout, pickle.dumps(arr, protocol=5))
-        stacked = [pickle.loads(o) for o in outs]
-        result = _REDUCERS[op](np.stack(stacked))
+
+    def _go(seq):
+        if g.backend == "xla":
+            if op == ReduceOp.PRODUCT:  # no pprod primitive: gather + prod
+                gathered = _xla_collective(g, "allgather", arr,
+                                           timeout=timeout, seq=seq)
+                return np.prod(gathered, axis=0)
+            return _xla_collective(g, _XLA_REDUCE[op], arr,
+                                   timeout=timeout, seq=seq)
+        outs = _phase(g, "ar", timeout, pickle.dumps(arr, protocol=5),
+                      seq=seq)
+        return _REDUCERS[op](np.stack([pickle.loads(o) for o in outs]))
+
+    result = _op(g, "allreduce", arr.nbytes, _go)
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result.astype(tensor.dtype, copy=False))
         return tensor
@@ -386,11 +513,18 @@ def allreduce_multigpu(tensor_list, group_name: str = "default", op=ReduceOp.SUM
 
 def allgather(tensor, group_name: str = "default", timeout: float = 120.0):
     g = _group(group_name)
-    if g.backend == "xla":
-        gathered = _xla_collective(g, "allgather", _to_numpy(tensor))
-        return [gathered[r] for r in range(g.world_size)]
-    outs = _phase(g, "ag", timeout, pickle.dumps(_to_numpy(tensor), protocol=5))
-    return [pickle.loads(o) for o in outs]
+    arr = _to_numpy(tensor)
+
+    def _go(seq):
+        if g.backend == "xla":
+            gathered = _xla_collective(g, "allgather", arr, timeout=timeout,
+                                       seq=seq)
+            return [gathered[r] for r in range(g.world_size)]
+        outs = _phase(g, "ag", timeout, pickle.dumps(arr, protocol=5),
+                      seq=seq)
+        return [pickle.loads(o) for o in outs]
+
+    return _op(g, "allgather", arr.nbytes, _go)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
@@ -403,32 +537,49 @@ def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
         raise ValueError(
             f"leading dim {arr.shape[0]} not divisible by world size {g.world_size}"
         )
-    if g.backend == "xla":
-        if op == ReduceOp.SUM:
-            return _xla_collective(g, "reducescatter", arr)
-        gathered = _xla_collective(g, "allgather", arr)
-        reduced = _REDUCERS[op](gathered)
+
+    def _go(seq):
+        if g.backend == "xla":
+            if op == ReduceOp.SUM:
+                return _xla_collective(g, "reducescatter", arr,
+                                       timeout=timeout, seq=seq)
+            gathered = _xla_collective(g, "allgather", arr, timeout=timeout,
+                                       seq=seq)
+            reduced = _REDUCERS[op](gathered)
+            return np.split(reduced, g.world_size, axis=0)[g.rank]
+        outs = _phase(g, "rs", timeout, pickle.dumps(arr, protocol=5),
+                      seq=seq)
+        reduced = _REDUCERS[op](np.stack([pickle.loads(o) for o in outs]))
         return np.split(reduced, g.world_size, axis=0)[g.rank]
-    outs = _phase(g, "rs", timeout, pickle.dumps(arr, protocol=5))
-    stacked = np.stack([pickle.loads(o) for o in outs])
-    reduced = _REDUCERS[op](stacked)
-    shards = np.split(reduced, g.world_size, axis=0)
-    return shards[g.rank]
+
+    return _op(g, "reducescatter", arr.nbytes, _go)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
               timeout: float = 120.0):
     g = _group(group_name)
-    if g.backend == "xla":
-        result = _xla_collective(g, "broadcast", _to_numpy(tensor),
-                                 extra=(src_rank,))
+    # Non-src store-backend ranks never touch their local tensor (its
+    # contents are about to be overwritten): materializing it here only
+    # to count bytes would force a device-to-host copy per broadcast.
+    # They contribute 0 payload bytes to the telemetry, which is honest.
+    if g.backend == "xla" or g.rank == src_rank:
+        arr = _to_numpy(tensor)
+        nbytes = arr.nbytes
     else:
+        arr, nbytes = None, 0
+
+    def _go(seq):
+        if g.backend == "xla":
+            return _xla_collective(g, "broadcast", arr, extra=(src_rank,),
+                                   timeout=timeout, seq=seq)
         if g.rank == src_rank:
-            payload = pickle.dumps(_to_numpy(tensor), protocol=5)
+            payload = pickle.dumps(arr, protocol=5)
         else:
             payload = b""
-        outs = _phase(g, "bc", timeout, payload)
-        result = pickle.loads(outs[src_rank])
+        outs = _phase(g, "bc", timeout, payload, seq=seq)
+        return pickle.loads(outs[src_rank])
+
+    result = _op(g, "broadcast", nbytes, _go)
     if isinstance(tensor, np.ndarray) and g.rank != src_rank:
         np.copyto(tensor, result.astype(tensor.dtype, copy=False))
         return tensor
@@ -437,10 +588,16 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
 
 def barrier(group_name: str = "default", timeout: float = 120.0):
     g = _group(group_name)
-    if g.backend == "xla":
-        _xla_collective(g, "psum", np.zeros((1,), np.float32))
-        return
-    _phase(g, "barrier", timeout, b"1")
+
+    def _go(seq):
+        if g.backend == "xla":
+            _xla_collective(g, "psum", np.zeros((1,), np.float32),
+                            timeout=timeout, seq=seq)
+            return None
+        _phase(g, "barrier", timeout, b"1", seq=seq)
+        return None
+
+    _op(g, "barrier", 0, _go)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):

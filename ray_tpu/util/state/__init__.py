@@ -30,6 +30,8 @@ __all__ = [
     "get_node_stats",
     "get_stacks",
     "timeline",
+    "train_timeline",
+    "steptrace_summary",
     "profile_cpu",
     "profile_memory",
     "metrics_summary",
@@ -357,6 +359,42 @@ def get_node_stats(node_id: str) -> Optional[dict]:
         if node["node_id"] == node_id:
             return _node_request(node, "node_stats")
     return None
+
+
+def steptrace_summary(limit: Optional[int] = None) -> dict:
+    """One cluster-wide step-observatory scrape, merged: collectives
+    joined by (group, seq) with per-rank arrival-skew attribution
+    (``skew``, ``last_rank``, ``missing``), step phases / step
+    boundaries / compile events per rank, and the GCS's rolling per-rank
+    straggler scores. Triggers the GCS-side metrics fold as a side
+    effect, so ``collective_skew_seconds`` and
+    ``steptrace_straggler_score`` advance on the /metrics scrape.
+    ``limit`` caps the merge to the newest N accumulated records (the
+    fold always ingests everything) — callers that only need the fold
+    side effect or a cheap summary pass a small limit."""
+    return _gcs_request("steptrace_cluster",
+                        {"limit": limit} if limit else {})
+
+
+def train_timeline(filename: Optional[str] = None) -> list:
+    """Merged multi-rank training timeline as Chrome-trace JSON
+    (Perfetto / chrome://tracing loadable): one process row per rank
+    with step boundaries, ``step_phase`` intervals, per-collective
+    slices annotated with (group, seq) arrival skew + the last-arriving
+    rank, and XLA compile events. The per-step complement of
+    ``ray_tpu.timeline()`` (which renders task scheduling): this one
+    shows where each training step's time actually goes and which rank
+    every collective waited on."""
+    import json
+
+    from ray_tpu._private import steptrace
+
+    merged = steptrace_summary()
+    trace = steptrace.chrome_trace(merged)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
 
 
 def profile_cpu(**kwargs):

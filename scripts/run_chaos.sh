@@ -40,6 +40,17 @@ if [ "$rc" -ne 0 ]; then
     else
         echo "(no live cluster to scrape)" >&2
     fi
+    # Step-observatory triage: dump the merged multi-rank train timeline
+    # (collective skew attribution + step phases) from any reachable
+    # cluster — a straggler-induced collective timeout shows up here as
+    # the rank every (group, seq) join waited on.
+    tl="${CHAOS_TRAIN_TIMELINE_DUMP:-/tmp/chaos_train_timeline.json}"
+    if timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu train timeline -o "$tl" >&2 2>/dev/null; then
+        echo "train timeline dump -> $tl" >&2
+    else
+        echo "(no live cluster for a train timeline dump)" >&2
+    fi
     # Log-plane triage: the cluster log listing plus the last error lines
     # of the streamed worker logs — what a driver would have seen — so a
     # crashed task's final output lands next to the failing lane's report.
