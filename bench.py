@@ -438,6 +438,79 @@ def _steptrace_overhead_main():
     os._exit(0)
 
 
+def _memview_overhead_main():
+    """BENCH_MEMVIEW_OVERHEAD=1: the memory observatory's acceptance
+    numbers on the put/get hot path. (a) tracking share: creation
+    records stamped during a tight store-put/get loop x calibrated
+    per-record cost (callsite frame walk + dict store) / wall time —
+    gated <2% (calibration x count estimator, same discipline as the
+    metrics/logs/steptrace lanes). (b) off posture: with memview
+    disabled the same loop must leave ZERO new records. Emits ONE JSON
+    line, same contract as the default bench path."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import memview
+
+    # calibrate the per-record cost, uncontended (record_put is the only
+    # memview hook on the put path; flows only fire on spill/transfer)
+    n_cal = 20_000
+    memview.set_enabled(True)
+    memview.reset()
+    cal_oid = b"\x01" * 28
+    t0 = time.perf_counter()
+    for _ in range(n_cal):
+        memview.record_put(cal_oid, 65536, "put")
+    per_record = (time.perf_counter() - t0) / n_cal
+    memview.reset()
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        # > max_direct_call_object_size (100KB): the slab-arena store
+        # path, not the inline memory store
+        arr = np.zeros(256 * 1024, np.uint8)
+
+        def put_get_loop(n=300):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ray_tpu.get(ray_tpu.put(arr))
+            return n, time.perf_counter() - t0
+
+        put_get_loop(n=30)  # warm the slab lease
+        # phase 1: enabled — calibrated tracking share of the loop
+        records_before = memview.record_calls()
+        ops, window_s = put_get_loop()
+        records = memview.record_calls() - records_before
+        share = records * per_record / window_s
+        # phase 2: disabled — the same loop must record NOTHING. Gate on
+        # the exact event counter (table/ring length deltas saturate)
+        events_before = memview.record_calls()
+        memview.set_enabled(False)
+        off_ops, off_window_s = put_get_loop()
+        off_records = memview.record_calls() - events_before
+        memview.set_enabled(True)
+    finally:
+        ray_tpu.shutdown()
+
+    ok = share < 0.02 and records >= ops and off_records == 0
+    print(json.dumps({
+        "metric": "memview_overhead_tracking_fraction",
+        "value": round(share, 6),
+        "unit": "fraction",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "per_record_cost_us": round(per_record * 1e6, 3),
+            "records_on": records,
+            "records_off": off_records,
+            "put_get_ops": ops,
+            "window_s": round(window_s, 4),
+            "ops_per_sec_on": round(ops / window_s, 1),
+            "ops_per_sec_off": round(off_ops / off_window_s, 1),
+        },
+    }), flush=True)
+    os._exit(0)
+
+
 def _object_plane_main():
     """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — put/get at
     100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram path). Gated on
@@ -480,6 +553,8 @@ def main():
         _log_overhead_main()
     if os.environ.get("BENCH_STEPTRACE_OVERHEAD"):
         _steptrace_overhead_main()
+    if os.environ.get("BENCH_MEMVIEW_OVERHEAD"):
+        _memview_overhead_main()
     if os.environ.get("BENCH_OBJECT_PLANE"):
         _object_plane_main()
 

@@ -212,6 +212,15 @@ _flag("steptrace_enabled", bool, True)
 _flag("steptrace_ring_size", int, 8192)
 # per-node fan-out timeout inside steptrace_cluster
 _flag("steptrace_scrape_timeout_s", float, 10.0)
+# Memory observatory (memview.py): object lifecycle + arena
+# introspection + leak attribution. memview_enabled gates every record
+# path (creation-callsite stamping at put(), the spill/restore/transfer
+# flow ring) — zero-cost off, same posture as metrics/steptrace.
+_flag("memview_enabled", bool, True)
+_flag("memview_track_max", int, 8192)  # creation records kept per process
+_flag("memview_flow_ring_size", int, 2048)  # flow events kept per process
+# per-node fan-out timeout inside memview_cluster
+_flag("memview_scrape_timeout_s", float, 10.0)
 # Collective / device plane
 _flag("collective_timeout_s", float, 120.0)
 _flag("tpu_autodetect", bool, False)

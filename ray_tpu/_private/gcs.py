@@ -1217,6 +1217,36 @@ class GcsServer:
         return merged
 
     # ------------------------------------------------------------------
+    # Memory observatory (memview.py): object lifecycle + arena
+    # introspection fan-out, joined into leak/pressure verdicts
+    # ------------------------------------------------------------------
+    async def rpc_memview_cluster(self, conn: Connection, p):
+        """One cluster-wide object-plane scrape: fan to every live
+        raylet (store ledger + arena introspection + its workers' owner
+        tables) plus registered DRIVER connections (drivers own most
+        objects), then join store rows against the union of every
+        process's reference set — an object resident in a store that NO
+        process references is an unreachable-yet-undeleted leak, grouped
+        by its creation callsite. The GCS object directory contributes
+        locations. Merge runs on an executor thread (pure python over
+        potentially 10k rows), mirroring steptrace_cluster's posture."""
+        from ray_tpu._private import memview
+
+        processes, n_nodes = await self._scrape_processes(
+            "memview_node", "memview_snapshot",
+            cfg.memview_scrape_timeout_s, tag_drivers=True)
+        locations = {
+            oid.hex(): sorted(nodes)
+            for oid, nodes in list(self.object_dir.items())[:50_000]
+        }
+        merged = await asyncio.get_running_loop().run_in_executor(
+            None, memview.merge_cluster, processes, locations)
+        merged["nodes"] = n_nodes
+        merged["errors"] = [proc for proc in processes
+                            if proc.get("error")]
+        return merged
+
+    # ------------------------------------------------------------------
     # Task events (observability; ray: gcs_task_manager.h)
     # ------------------------------------------------------------------
     async def rpc_list_objects(self, conn: Connection, p):

@@ -36,7 +36,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional
 
-from ray_tpu._private import slab_arena
+from ray_tpu._private import memview, slab_arena
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
 from ray_tpu._private.ids import ObjectID
 
@@ -56,7 +56,7 @@ _MX = None
 class _StoreMetrics:
     __slots__ = ("put_lat", "put_bytes", "get_lat", "get_bytes",
                  "ext_hits", "ext_misses", "spills", "restores",
-                 "slab_puts", "file_puts", "overshoot")
+                 "slab_puts", "file_puts", "overshoot", "overshoot_cause")
 
     def __init__(self):
         from ray_tpu._private import metrics_core as mc
@@ -95,6 +95,12 @@ class _StoreMetrics:
             "object_store_overshoot_bytes_total",
             "Bytes admitted past capacity (already-written externals "
             "and untracked restores)").default
+        # cause-labeled twin of the total above: pressure verdicts name
+        # register_external (fallback writes) vs untracked_restore
+        # instead of pointing at a raw counter
+        self.overshoot_cause = reg.counter(
+            "object_store_overshoot_attributed_bytes_total",
+            "Bytes admitted past capacity, by cause")
 
 
 def _mx() -> "_StoreMetrics":
@@ -305,7 +311,8 @@ def make_local_store(store_dir: str, capacity_bytes: int,
 class _Segment:
     """Owner-side record of one slab segment."""
 
-    __slots__ = ("seg_id", "size", "leased_to", "last_access", "live")
+    __slots__ = ("seg_id", "size", "leased_to", "last_access", "live",
+                 "writer", "live_bytes", "dead")
 
     def __init__(self, seg_id: int, size: int, leased_to: Optional[str]):
         self.seg_id = seg_id
@@ -313,6 +320,14 @@ class _Segment:
         self.leased_to = leased_to  # client_id, "_local", or None=sealed
         self.last_access = time.monotonic()
         self.live: set = set()  # ObjectIDs resident in this segment
+        # memory observatory (memview.py): the writing client survives
+        # the seal (leased_to goes None) so per-client slab charge and
+        # object ownership stay attributable, and deleted entries leave
+        # their byte ranges behind — the literal input to a future
+        # fallocate(PUNCH_HOLE) reclamation pass
+        self.writer = leased_to
+        self.live_bytes = 0
+        self.dead: Dict[int, int] = {}  # entry offset -> entry bytes
 
 
 class LocalObjectStore:
@@ -346,6 +361,10 @@ class LocalObjectStore:
         self._pinned: Dict[ObjectID, int] = {}
         self._used = 0
         self._spilled: Dict[ObjectID, int] = {}  # oid -> size on disk
+        # when each object left shm (memview: leak verdicts age-gate
+        # against in-flight reports, so every lifecycle state needs an
+        # age — arena rows carry their created ts in _slab_objs)
+        self._spilled_at: Dict[ObjectID, float] = {}
         # restored-from-external objects whose backend copy still exists
         # (cleaned at delete); and oids whose one restart-recovery probe
         # already missed (never probe the backend again for them) —
@@ -356,10 +375,19 @@ class LocalObjectStore:
         self.spilled_bytes_total = 0
         self.restored_bytes_total = 0
         self.overshoot_bytes_total = 0
+        # overshoot attributed to its admission path (memview pressure
+        # verdicts name the cause): register_external | untracked_restore
+        self.overshoot_by_cause: Dict[str, int] = {}
         # --- slab arena (owner side) ----------------------------------
         self.arena_enabled = cfg.slab_arena if arena is None else arena
         self._segments: Dict[int, _Segment] = {}
-        self._slab_objs: Dict[ObjectID, tuple] = {}  # oid -> (seg, off, len)
+        # oid -> (seg, off, len, created_monotonic)
+        self._slab_objs: Dict[ObjectID, tuple] = {}
+        # rolling arena occupancy (memview gauges: fragmentation ratio =
+        # dead / (live + dead)); maintained at adopt/forget/unlink so a
+        # metrics scrape never walks the ledger
+        self._slab_live_bytes = 0
+        self._slab_dead_bytes = 0
         # deletes racing in-flight accounting reports (bounded FIFO —
         # frees of inline objects the store never saw land here too, and
         # must not pin memory or evict the cap into uselessness)
@@ -374,6 +402,7 @@ class LocalObjectStore:
         # the charge stays on _used until the entry drains or is reused.
         self._pool: "OrderedDict[str, tuple]" = OrderedDict()
         self._pool_seq = 0
+        self._pool_pinned_cache: tuple = (0.0, [])  # (ts, last probe)
         self._index = None
         self._local_writer = None
         if self.arena_enabled:
@@ -416,6 +445,10 @@ class LocalObjectStore:
             seg = _Segment(seg_id, 0, leased_to=None)
             end = self._reconcile_segment_locked(seg)
             if not seg.live:
+                # retire the dead-range tally with the file: this
+                # segment never enters _segments, so its scan-counted
+                # dead bytes would otherwise pin the gauge forever
+                self._slab_dead_bytes -= sum(seg.dead.values())
                 try:
                     os.unlink(path)
                 except OSError:
@@ -524,6 +557,14 @@ class LocalObjectStore:
         if not seg.live:
             self._unlink_segment_locked(seg)
 
+    def _mark_dead_range_locked(self, seg: _Segment, off: int, total: int):
+        """Account one dead entry range (idempotent: reconcile re-scans
+        segments, and a range must count once)."""
+        if off in seg.dead:
+            return
+        seg.dead[off] = total
+        self._slab_dead_bytes += total
+
     def _reconcile_segment_locked(self, seg: _Segment) -> int:
         """Scan a segment's sealed prefix into the ledger; returns the
         scan end offset. Idempotent with worker reports."""
@@ -532,6 +573,7 @@ class LocalObjectStore:
         for oid_b, off, _ml, _dl, total, dead in slab_arena.scan_segment(path):
             end = off + total
             if dead:
+                self._mark_dead_range_locked(seg, off, total)
                 continue
             oid = ObjectID(oid_b)
             if oid in self._slab_objs:
@@ -543,9 +585,12 @@ class LocalObjectStore:
                 self._pending_deletes.pop(oid, None)
                 slab_arena.mark_dead_at(self.store_dir, seg.seg_id, off)
                 self._index.mark_dead(oid_b)
+                self._mark_dead_range_locked(seg, off, total)
                 continue
             seg.live.add(oid)
-            self._slab_objs[oid] = (seg.seg_id, off, total)
+            seg.live_bytes += total
+            self._slab_live_bytes += total
+            self._slab_objs[oid] = (seg.seg_id, off, total, time.monotonic())
             self._index.insert(oid_b, seg.seg_id, off)
         return end
 
@@ -571,12 +616,18 @@ class LocalObjectStore:
                     # delete below can mark it dead, never resurrect it
                     self._pending_deletes.pop(oid, None)
                     seg.live.add(oid)
-                    self._slab_objs[oid] = (seg.seg_id, off, total)
+                    seg.live_bytes += total
+                    self._slab_live_bytes += total
+                    self._slab_objs[oid] = (seg.seg_id, off, total,
+                                            time.monotonic())
                     deletes.append(oid)
                     continue
                 seg.live.add(oid)
+                seg.live_bytes += total
+                self._slab_live_bytes += total
                 seg.last_access = time.monotonic()
-                self._slab_objs[oid] = (seg.seg_id, off, total)
+                self._slab_objs[oid] = (seg.seg_id, off, total,
+                                        time.monotonic())
                 self._probe_missed.pop(oid, None)
                 new.append(oid.binary())
         for oid in deletes:
@@ -612,6 +663,12 @@ class LocalObjectStore:
         pool (warm pages for the next lease), unlink the rest."""
         path = slab_arena.segment_path(self.store_dir, seg.seg_id)
         self._segments.pop(seg.seg_id, None)
+        # its dead ranges leave the arena with it (pooled files are
+        # state-wiped; unlinked files are gone)
+        self._slab_dead_bytes -= sum(seg.dead.values())
+        self._slab_live_bytes -= seg.live_bytes
+        seg.dead = {}
+        seg.live_bytes = 0
         pool_cap = max(cfg.slab_size_bytes * 2, self.capacity // 4)
         pooled_bytes = sum(c for _f, c in self._pool.values())
         if seg.size >= self._POOL_MIN_BYTES \
@@ -641,13 +698,18 @@ class LocalObjectStore:
         ent = self._slab_objs.pop(object_id, None)
         if ent is None:
             return
-        seg_id, off, _total = ent
+        seg_id, off, total = ent[:3]
         if mark_dead:
             slab_arena.mark_dead_at(self.store_dir, seg_id, off)
             self._index.mark_dead(object_id.binary())
         seg = self._segments.get(seg_id)
         if seg is not None:
             seg.live.discard(object_id)
+            seg.live_bytes -= total
+            self._slab_live_bytes -= total
+            # discarded-behind-the-ledger entries (mark_dead=False) are
+            # dead bytes in the segment all the same
+            self._mark_dead_range_locked(seg, off, total)
             if not seg.live and seg.leased_to is None:
                 self._unlink_segment_locked(seg)
 
@@ -753,16 +815,20 @@ class LocalObjectStore:
                     self._ensure_space_locked(size)
                 except ObjectStoreFullError:
                     # already written: track the overshoot honestly
-                    self._count_overshoot_locked(size)
+                    self._count_overshoot_locked(size, "register_external")
                 self._sizes[object_id] = size
                 self._used += size
                 self._lru[object_id] = time.monotonic()
 
-    def _count_overshoot_locked(self, size: int):
+    def _count_overshoot_locked(self, size: int, cause: str):
         over = min(size, max(0, self._used + size - self.capacity))
         if over > 0:
             self.overshoot_bytes_total += over
-            _mx().overshoot.inc(over)
+            self.overshoot_by_cause[cause] = \
+                self.overshoot_by_cause.get(cause, 0) + over
+            mx = _mx()
+            mx.overshoot.inc(over)
+            mx.overshoot_cause.labels(cause=cause).inc(over)
 
     # -- read path -----------------------------------------------------------
     def _slab_read(self, object_id: ObjectID) -> Optional[ObjectBuffer]:
@@ -770,7 +836,7 @@ class LocalObjectStore:
         with self._lock:
             ent = self._slab_objs.get(object_id)
         if ent is not None:
-            seg_id, off, _total = ent
+            seg_id, off = ent[0], ent[1]
             got = slab_arena.read_at(self.store_dir, seg_id, off,
                                      object_id.binary())
             if got is not None:
@@ -940,6 +1006,7 @@ class LocalObjectStore:
         counts survive: a spilled primary copy is still the primary."""
         src = _obj_path(self.store_dir, object_id)
         size = self._sizes.get(object_id, 0)
+        t0 = time.perf_counter()
         try:
             self._external.spill(self._spill_key(object_id), src)
             os.unlink(src)
@@ -949,8 +1016,11 @@ class LocalObjectStore:
         self._lru.pop(object_id, None)
         self._used -= size
         self._spilled[object_id] = size
+        self._spilled_at[object_id] = time.monotonic()
         self.spilled_bytes_total += size
         _mx().spills.inc()
+        memview.record_flow("spill", size, time.perf_counter() - t0,
+                            "file", object_id.hex())
         return True
 
     def _spill_slab_object_locked(self, object_id: ObjectID) -> bool:
@@ -960,7 +1030,8 @@ class LocalObjectStore:
         ent = self._slab_objs.get(object_id)
         if ent is None:
             return False
-        seg_id, off, _total = ent
+        seg_id, off = ent[0], ent[1]
+        t0 = time.perf_counter()
         got = slab_arena.read_at(self.store_dir, seg_id, off,
                                  object_id.binary())
         if got is None:  # discarded behind the ledger
@@ -992,8 +1063,13 @@ class LocalObjectStore:
         self._drop_staged_locked(staging, src)
         self._forget_slab_obj_locked(object_id)
         self._spilled[object_id] = size
+        self._spilled_at[object_id] = time.monotonic()
         self.spilled_bytes_total += size
         _mx().spills.inc()
+        # arena path: bytes left straight from the slab mapping (the
+        # one disk write is the staged interop file)
+        memview.record_flow("spill", size, time.perf_counter() - t0,
+                            "arena", object_id.hex())
         return True
 
     @staticmethod
@@ -1043,6 +1119,7 @@ class LocalObjectStore:
                 except ObjectStoreFullError:
                     return False
             dst = _obj_path(self.store_dir, object_id)
+            t0 = time.perf_counter()
             try:
                 ok = self._external.restore(
                     self._spill_key(object_id), dst
@@ -1064,14 +1141,18 @@ class LocalObjectStore:
                 try:
                     self._ensure_space_locked(size)
                 except ObjectStoreFullError:
-                    self._count_overshoot_locked(size)
+                    self._count_overshoot_locked(size, "untracked_restore")
             self._spilled.pop(object_id, None)
+            self._spilled_at.pop(object_id, None)
             self._ever_spilled.add(object_id)
             self._sizes[object_id] = size
             self._used += size
             self._lru[object_id] = time.monotonic()
             self.restored_bytes_total += size
             _mx().restores.inc()
+            memview.record_flow("restore", size,
+                                time.perf_counter() - t0, "file",
+                                object_id.hex())
             return True
 
     # -- lifecycle -----------------------------------------------------------
@@ -1138,6 +1219,7 @@ class LocalObjectStore:
             except FileNotFoundError:
                 pass
         was_spilled = self._spilled.pop(object_id, None) is not None
+        self._spilled_at.pop(object_id, None)
         if (was_spilled or object_id in self._ever_spilled) \
                 and self._external is not None:
             self._ever_spilled.discard(object_id)
@@ -1225,16 +1307,189 @@ class LocalObjectStore:
 
     def spilled_stats(self):
         with self._lock:
-            return {
-                "spilled_objects": len(self._spilled),
-                "spilled_bytes_total": self.spilled_bytes_total,
-                "restored_bytes_total": self.restored_bytes_total,
-                "overshoot_bytes_total": self.overshoot_bytes_total,
-                "slab_segments": len(self._segments),
-                "slab_objects": len(self._slab_objs),
-            }
+            return self._spilled_stats_locked()
+
+    def _spilled_stats_locked(self):
+        return {
+            "spilled_objects": len(self._spilled),
+            "spilled_bytes_total": self.spilled_bytes_total,
+            "restored_bytes_total": self.restored_bytes_total,
+            "overshoot_bytes_total": self.overshoot_bytes_total,
+            "overshoot_by_cause": dict(self.overshoot_by_cause),
+            "slab_segments": len(self._segments),
+            "slab_objects": len(self._slab_objs),
+        }
 
     def object_ids(self):
         with self._lock:
             return list(self._sizes.keys()) + list(self._slab_objs.keys()) \
                 + list(self._spilled.keys())
+
+    # -- memory observatory (memview.py) -------------------------------------
+    def arena_dead_bytes(self) -> int:
+        return self._slab_dead_bytes
+
+    def arena_live_bytes(self) -> int:
+        return self._slab_live_bytes
+
+    def arena_fragmentation(self) -> float:
+        """dead / (live + dead) resident slab bytes — the share a
+        hole-punch pass could reclaim from live segments."""
+        total = self._slab_dead_bytes + self._slab_live_bytes
+        return self._slab_dead_bytes / total if total else 0.0
+
+    def pool_pinned(self, max_age_s: float = 0.0) -> List[dict]:
+        """Recycling-pool segments a reader's SHARED flock keeps alive
+        (an EXCLUSIVE non-blocking probe fails): previously invisible —
+        a stuck zero-copy view pinned pages forever with nothing to
+        blame. Reports the pinning pid(s) from /proc/locks.
+
+        The probe runs UNDER the store lock so it serializes with
+        ``_reuse_pooled_locked``'s identical EX probe — two transient
+        exclusive locks racing would make the recycler skip a reusable
+        segment and this report name the raylet's own pid as a phantom
+        pinner. ``max_age_s`` serves a recent cached result instead of
+        re-probing (the per-scrape gauge path; introspection and tests
+        pass 0 for ground truth)."""
+        import fcntl
+
+        if max_age_s > 0.0:
+            ts, cached = self._pool_pinned_cache
+            if time.monotonic() - ts < max_age_s:
+                return cached
+        # our own reader cache legitimately holds SHARED flocks of
+        # pooled (path-vanished) segments: release those first so the
+        # probe reports FOREIGN pins, not our own cache. Outside the
+        # store lock (the view has its own; lock order store->view is
+        # the established one — see _reuse_pooled_locked).
+        slab_arena.view(self.store_dir).sweep()
+        out: List[dict] = []
+        with self._lock:
+            for path, (fsize, charged) in list(self._pool.items()):
+                try:
+                    fd = os.open(path, os.O_RDWR)
+                except OSError:
+                    continue  # drained/reused concurrently
+                try:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        fcntl.flock(fd, fcntl.LOCK_UN)
+                    except OSError:
+                        out.append({
+                            "file": os.path.basename(path),
+                            "file_size": fsize,
+                            "charged": charged,
+                            "holder_pids": memview.flock_holders(path),
+                        })
+                finally:
+                    os.close(fd)
+        self._pool_pinned_cache = (time.monotonic(), out)
+        return out
+
+    def arena_introspect(self) -> dict:
+        """Owner-side arena summary: per-segment occupancy with live vs
+        dead entry counts and coalesced **dead byte ranges** (the input
+        a ``fallocate(PUNCH_HOLE)`` reclamation pass would punch),
+        recycling-pool and leased-vs-sealed stats, per-client slab
+        charge, and the spill/overshoot tallies — the ``arena`` block of
+        this node's memview snapshot."""
+        now = time.monotonic()
+        with self._lock:
+            segs = []
+            per_client: Dict[str, int] = {}
+            for seg in sorted(self._segments.values(),
+                              key=lambda s: s.seg_id):
+                dead_bytes = sum(seg.dead.values())
+                denom = seg.live_bytes + dead_bytes
+                segs.append({
+                    "seg_id": seg.seg_id,
+                    "size": seg.size,
+                    "leased_to": seg.leased_to,
+                    "writer": seg.writer,
+                    "live_entries": len(seg.live),
+                    "dead_entries": len(seg.dead),
+                    "live_bytes": seg.live_bytes,
+                    "dead_bytes": dead_bytes,
+                    "dead_ranges": memview.coalesce_ranges(
+                        seg.dead.items()),
+                    "fragmentation": dead_bytes / denom if denom else 0.0,
+                    "idle_s": round(now - seg.last_access, 3),
+                })
+                charge_to = seg.leased_to or seg.writer or "_unknown"
+                per_client[charge_to] = \
+                    per_client.get(charge_to, 0) + seg.size
+            pool = [{"file": os.path.basename(p), "file_size": f,
+                     "charged": c} for p, (f, c) in self._pool.items()]
+            out = {
+                "capacity": self.capacity,
+                "used": self._used,
+                "live_bytes": self._slab_live_bytes,
+                "dead_bytes": self._slab_dead_bytes,
+                "fragmentation": self.arena_fragmentation(),
+                "segments": segs,
+                "leased_segments": sum(
+                    1 for s in self._segments.values() if s.leased_to),
+                "sealed_segments": sum(
+                    1 for s in self._segments.values() if not s.leased_to),
+                "pool": pool,
+                "pool_bytes": sum(c for _f, c in self._pool.values()),
+                "per_client_bytes": per_client,
+                "file_objects": len(self._sizes),
+                "file_bytes": sum(self._sizes.values()),
+                "pinned_objects": len(self._pinned),
+                "spilled": self._spilled_stats_locked(),
+            }
+        out["pool_pinned"] = self.pool_pinned()  # probes flocks: no lock
+        return out
+
+    def memview_objects(self, limit: int = 10_000) -> List[dict]:
+        """Per-object lifecycle rows from this store's ledger: state
+        (arena / external one-file / spilled), size, backing segment,
+        pin count, owner (the segment's writing client), and age."""
+        from itertools import islice
+
+        now = time.monotonic()
+        rows: List[dict] = []
+        with self._lock:
+            for oid, ent in islice(self._slab_objs.items(), limit):
+                seg_id, off, total = ent[:3]
+                ts = ent[3] if len(ent) > 3 else None
+                seg = self._segments.get(seg_id)
+                rows.append({
+                    "object_id": oid.hex(),
+                    "state": "arena",
+                    "size": total,
+                    "seg": seg_id,
+                    "off": off,
+                    "pins": self._pinned.get(oid, 0),
+                    "owner": seg.writer if seg is not None else None,
+                    "age_s": round(now - ts, 3) if ts is not None else None,
+                })
+            room = max(0, limit - len(rows))
+            for oid, size in islice(self._sizes.items(), room):
+                ts = self._lru.get(oid)
+                rows.append({
+                    "object_id": oid.hex(),
+                    "state": "external",
+                    "size": size,
+                    "pins": self._pinned.get(oid, 0),
+                    # time-since-last-touch is a FLOOR on age: enough
+                    # for the leak verdicts' in-flight-report gate (a
+                    # just-registered object reads young)
+                    "age_s": round(now - ts, 3) if ts is not None
+                    else None,
+                    "idle_s": round(now - ts, 3) if ts is not None
+                    else None,
+                })
+            room = max(0, limit - len(rows))
+            for oid, size in islice(self._spilled.items(), room):
+                ts = self._spilled_at.get(oid)
+                rows.append({
+                    "object_id": oid.hex(),
+                    "state": "spilled",
+                    "size": size,
+                    "pins": self._pinned.get(oid, 0),
+                    "age_s": round(now - ts, 3) if ts is not None
+                    else None,
+                })
+        return rows

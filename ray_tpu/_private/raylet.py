@@ -525,6 +525,31 @@ class Raylet:
         gauge("raylet_store_spilled_objects",
               "Objects currently spilled out of shm",
               lambda: self.store.spilled_stats()["spilled_objects"])
+        # memory observatory (memview.py): arena occupancy gauges on the
+        # cluster scrape — dead bytes inside live segments are the
+        # hole-punch reclamation candidates, and a pooled segment pinned
+        # by a reader's SHARED flock is a stuck-view leak. Guarded: the
+        # native store (slab_arena=0) has no arena ledger.
+        st = self.store
+        if hasattr(st, "arena_dead_bytes"):
+            gauge("slab_arena_dead_bytes",
+                  "Dead (hole-punch-reclaimable) bytes inside live slab "
+                  "segments", st.arena_dead_bytes)
+            gauge("slab_arena_live_bytes",
+                  "Live object bytes resident in slab segments",
+                  st.arena_live_bytes)
+            gauge("slab_arena_fragmentation_ratio",
+                  "dead / (live + dead) resident slab bytes",
+                  st.arena_fragmentation)
+        if hasattr(st, "pool_pinned"):
+            # TTL-cached: a flock probe per pooled file per scrape is
+            # cheap, but metrics scrapes can arrive from several pollers
+            reg.gauge(
+                "slab_segments_pinned",
+                "Recycling-pool segments kept alive only by a reader's "
+                "SHARED flock",
+            ).labels(**dict(tags, reason="reader_flock")).set_fn(
+                lambda: len(st.pool_pinned(max_age_s=5.0)))
         # log plane self-measurement (channel-tagged: the "logs" pubsub
         # channel is the only one carrying log records today)
         ltags = dict(tags, channel="logs")
@@ -2324,6 +2349,7 @@ class Raylet:
 
     async def _fetch_from(self, peer: Connection, oid: ObjectID) -> bool:
         chunk = cfg.object_transfer_chunk_bytes
+        t0 = time.perf_counter()
         try:
             first = await peer.request(
                 "fetch_object", {"object_id": oid.binary(), "offset": 0, "chunk": chunk},
@@ -2355,6 +2381,14 @@ class Raylet:
                 parts.append(nxt["data"])
                 got += len(nxt["data"])
             self.store.put(oid, metadata, parts, total)
+            # "heap": chunks assembled through heap buffers before the
+            # store put — the copy receive-side slab assembly (ROADMAP)
+            # will remove; the flow log is its measurement basis
+            from ray_tpu._private import memview
+
+            memview.record_flow("fetch", total,
+                                time.perf_counter() - t0, "heap",
+                                oid.hex())
             return True
         finally:
             self._pull_gate.uncharge(total)
@@ -2399,6 +2433,7 @@ class Raylet:
         buf = self.store.get(oid)
         if buf is None:
             return False
+        t0 = time.perf_counter()
         try:
             total = len(buf.data)
             chunk = cfg.object_transfer_chunk_bytes
@@ -2458,8 +2493,18 @@ class Raylet:
             # success requires an explicit landing ack (assembled / have):
             # per-chunk acks alone can all succeed while the receiver's
             # session expired mid-push and the object never materialized
-            return (sent_all and all(r is True for r in results)
-                    and landed[0])
+            ok = (sent_all and all(r is True for r in results)
+                  and landed[0])
+            if ok:
+                from ray_tpu._private import memview
+
+                # sender path: zero-copy views straight off the slab
+                # ("arena") vs a legacy file mapping ("file")
+                memview.record_flow(
+                    "push", total, time.perf_counter() - t0,
+                    "arena" if buf.seg_id is not None else "file",
+                    oid.hex())
+            return ok
         finally:
             buf.release()
 
@@ -2504,7 +2549,8 @@ class Raylet:
                 self._pull_gate.uncharge(p["total"])
             else:
                 st = self._push_rx[key] = {
-                    "parts": {}, "meta": None, "total": p["total"], "ts": now,
+                    "parts": {}, "meta": None, "total": p["total"],
+                    "ts": now, "t0": now,
                 }
         st["ts"] = now
         st["parts"][p["offset"]] = p["data"]
@@ -2517,6 +2563,13 @@ class Raylet:
                 self.store.put(oid, st["meta"], parts, st["total"])
             self._push_rx.pop(key, None)
             self._pull_gate.uncharge(st["total"])
+            from ray_tpu._private import memview
+
+            # receive side assembles through heap chunk buffers today —
+            # flagged "heap" so receive-side slab assembly can A/B
+            memview.record_flow("push_rx", st["total"],
+                                now - st.get("t0", now), "heap",
+                                oid.hex())
             # unblock local pull waiters and register the new copy
             fut = self._pulls_inflight.get(oid.binary())
             if fut is not None and not fut.done():
@@ -2878,6 +2931,59 @@ class Raylet:
             return out
 
         processes = list(await asyncio.gather(*[one(w) for w in live]))
+        return {"node_id": self.node_id, "processes": processes}
+
+    # -- memory observatory (memview.py) -------------------------------
+    async def rpc_memview_node(self, conn: Connection, p):
+        """This node's object-plane view: every live worker's memview
+        snapshot (owned tables + reference sets + flow rings), gathered
+        CONCURRENTLY, plus the raylet's own snapshot carrying the store
+        ledger — per-object lifecycle rows and the arena introspection
+        (segment occupancy, dead byte ranges, recycling pool, per-client
+        charge, overshoot attribution)."""
+        from ray_tpu._private import memview
+
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+
+        async def one(w: _Worker):
+            try:
+                out = await w.conn.request(
+                    "memview_snapshot", {},
+                    timeout=cfg.memview_scrape_timeout_s)
+            except Exception as e:
+                return {"pid": w.proc.pid, "node_id": self.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+            out.setdefault("node_id", self.node_id)
+            return out
+
+        limit = (p or {}).get("limit") or 10_000
+
+        def collect():
+            # store introspection is lock-held python over up to `limit`
+            # ledger rows plus flock probes of the recycling pool: run
+            # it on an executor thread so a full store never stalls the
+            # raylet event loop (heartbeats, dispatch, pushes).
+            # getattr-guarded: the native C++ store (slab_arena=0) has
+            # no introspection surface yet — the node still reports its
+            # workers.
+            own = memview.process_snapshot({"node_id": self.node_id,
+                                            "role": "raylet"})
+            intro = getattr(self.store, "arena_introspect", None)
+            objs = getattr(self.store, "memview_objects", None)
+            own["store"] = {
+                "arena": intro() if intro is not None else None,
+                "objects": objs(limit) if objs is not None else [],
+            }
+            return own
+
+        workers, own = await asyncio.gather(
+            asyncio.gather(*[one(w) for w in live]),
+            asyncio.get_running_loop().run_in_executor(None, collect),
+        )
+        processes = list(workers) + [own]
         return {"node_id": self.node_id, "processes": processes}
 
     # ------------------------------------------------------------------

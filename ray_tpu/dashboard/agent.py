@@ -15,6 +15,9 @@ Routes:
       merged; also at /api/v0/metrics, ?format=json for raw snapshots)
   GET /api/v0/steptrace — node-local step-observatory rings (this
       raylet's workers; cross-rank skew merges at the GCS)
+  GET /api/v0/memview — node-local memory observatory (this raylet's
+      store ledger + arena introspection + its workers' owner tables;
+      cluster-wide leak verdicts merge at the GCS)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
   GET /api/v0/logs/range?file=<name>&start=A&end=B — exact byte range
@@ -165,6 +168,15 @@ class Agent:
         conn = await self._raylet()
         return _json(await conn.request("steptrace_node", {}, timeout=30))
 
+    async def memview(self, request):
+        """Node-local memory observatory: the store ledger's object
+        rows, arena segment introspection (dead ranges, pool, per-client
+        charge), and this node's workers' owner tables — the per-node
+        analog of the head's /api/v0/memory. Cluster-wide leak verdicts
+        need the GCS merge; this surface is for poking one node."""
+        conn = await self._raylet()
+        return _json(await conn.request("memview_node", {}, timeout=30))
+
     async def logs(self, request):
         log_dir = os.path.join(self.session_dir, "logs")
         out = []
@@ -244,6 +256,7 @@ async def amain(args) -> None:
     app.router.add_get("/metrics", agent.metrics)
     app.router.add_get("/api/v0/metrics", agent.metrics)
     app.router.add_get("/api/v0/steptrace", agent.steptrace)
+    app.router.add_get("/api/v0/memview", agent.memview)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
     app.router.add_get("/api/v0/logs/range", agent.range)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import threading
 import time
 from collections import deque
@@ -70,11 +71,78 @@ def _build_app():
     routes.get("/api/v0/nodes")(_listing(state.list_nodes))
     routes.get("/api/v0/actors")(_listing(state.list_actors))
     routes.get("/api/v0/tasks")(_listing(state.list_tasks))
-    routes.get("/api/v0/objects")(_listing(state.list_objects))
     routes.get("/api/v0/placement_groups")(
         _listing(state.list_placement_groups)
     )
     routes.get("/api/v0/jobs")(_listing(state.list_jobs))
+
+    # One memview_cluster scrape is a cluster-wide fan-out (every
+    # raylet, worker, and driver): the objects and memory tabs polling
+    # every 5s must share ONE recent scrape, not trigger one each. The
+    # lock serializes concurrent misses (handlers run on executor
+    # threads) so two viewers share a single fan-out.
+    _memview_cache = {"ts": 0.0, "data": None}
+    _memview_cache_lock = threading.Lock()
+
+    def _object_summary_cached() -> dict:
+        with _memview_cache_lock:
+            now = time.monotonic()
+            if _memview_cache["data"] is not None \
+                    and now - _memview_cache["ts"] < 4.0:
+                return _memview_cache["data"]
+            data = state.object_summary()
+            _memview_cache["ts"] = time.monotonic()
+            _memview_cache["data"] = data
+            return data
+
+    @routes.get("/api/v0/objects")
+    async def objects(request):
+        """Object lifecycle rows from the memory observatory (state,
+        size, owner, refs, locations, creation callsite). The bare GCS
+        directory is the fallback BOTH when the memview scrape fails
+        and when it has no rows — a native-store cluster
+        (slab_arena=0) reports workers but no store ledger, and an
+        empty lifecycle listing must not mask live directory entries."""
+        limit = request.query.get("limit")
+        limit = int(limit) if limit else 500
+
+        def run():
+            try:
+                rows = (_object_summary_cached().get("objects")
+                        or [])[:limit]
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "memview scrape failed; serving the bare object "
+                    "directory", exc_info=True)
+                rows = []
+            return rows or state.list_objects(limit=limit)
+
+        out = await asyncio.get_running_loop().run_in_executor(None, run)
+        return _json_response(out)
+
+    @routes.get("/api/v0/memory")
+    async def memory(request):
+        """Memory observatory for the Memory tab: object lifecycle rows,
+        per-node arena introspection (dead ranges, fragmentation, pool),
+        the flow log, and leak/pressure verdicts — one memview_cluster
+        scrape (what `ray_tpu memory` prints)."""
+        group_by = request.query.get("group_by") or None
+
+        def run():
+            from ray_tpu._private import memview
+
+            merged = dict(_object_summary_cached())
+            if group_by:
+                merged["groups"] = memview.group_objects(
+                    merged.get("objects") or [], group_by)
+            return merged
+
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, run)
+        except ValueError as e:
+            return _json_response({"error": str(e)}, status=400)
+        return _json_response(out)
 
     @routes.get("/api/v0/tasks/summarize")
     async def summarize(request):

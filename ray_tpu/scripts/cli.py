@@ -296,6 +296,91 @@ def cmd_metrics(args):
         ray_tpu.shutdown()
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024 or unit == "T":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}T"
+
+
+def cmd_memory(args):
+    """Memory observatory: one cluster-wide object-plane scrape — what
+    objects exist (state/size/owner/refs/callsite), per-node arena
+    occupancy with dead-byte ranges and fragmentation, the recent
+    spill/restore/push/fetch flow log, and leak/pressure verdicts."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli")
+    try:
+        merged = state.object_summary(group_by=args.group_by)
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(merged, f, indent=2, default=str)
+            print(f"memory observatory dump -> {args.output}")
+        totals = merged.get("totals") or {}
+        total_bytes = sum(t["bytes"] for t in totals.values())
+        total_count = sum(t["count"] for t in totals.values())
+        states = " / ".join(
+            f"{s} {t['count']} ({_fmt_bytes(t['bytes'])})"
+            for s, t in sorted(totals.items()))
+        print(f"cluster objects: {total_count} "
+              f"({_fmt_bytes(total_bytes)}): {states or 'none'}")
+        for a in merged.get("arenas") or ():
+            nid = str(a.get("node_id") or "?")[:12]
+            pinned = a.get("pool_pinned") or []
+            pin_note = "".join(
+                f", {len(pinned)} pinned by pid "
+                f"{','.join(map(str, e.get('holder_pids') or ['?']))}"
+                for e in pinned[:1])
+            spilled = a.get("spilled") or {}
+            print(f"node {nid}: {len(a.get('segments') or ())} segs "
+                  f"({a.get('leased_segments', 0)} leased), "
+                  f"live {_fmt_bytes(a.get('live_bytes'))}, "
+                  f"dead {_fmt_bytes(a.get('dead_bytes'))} "
+                  f"(frag {100 * (a.get('fragmentation') or 0):.1f}%), "
+                  f"pool {len(a.get('pool') or ())}{pin_note}, "
+                  f"spilled {spilled.get('spilled_objects', 0)}, "
+                  f"overshoot "
+                  f"{_fmt_bytes(spilled.get('overshoot_bytes_total'))}")
+        if args.group_by:
+            print(f"objects by {args.group_by}:")
+            for g in (merged.get("groups") or ())[:20]:
+                print(f"  {_fmt_bytes(g['bytes']):>10s}  "
+                      f"{g['count']:>5d}  {g['key']}")
+        verdicts = merged.get("verdicts") or []
+        leaks = [v for v in verdicts if v["kind"] == "leak"]
+        other = [v for v in verdicts if v["kind"] != "leak"]
+        for v in other:
+            where = str(v.get("node_id") or "?")[:12]
+            extra = f" pids={v['holder_pids']}" \
+                if v.get("holder_pids") else ""
+            extra += f" cause={v['cause']}" if v.get("cause") else ""
+            print(f"! {v['kind']} on {where}: "
+                  f"{_fmt_bytes(v.get('bytes'))}{extra} — {v['detail']}")
+        if args.leaks:
+            if not leaks:
+                print("no leak verdicts: every resident object is "
+                      "referenced by a live process")
+            for v in leaks:
+                age = f" age={v['age_s']:.0f}s" if v.get("age_s") else ""
+                site = f" callsite {v['callsite']}" \
+                    if v.get("callsite") else ""
+                print(f"! leak ({v['confidence']}): "
+                      f"{_fmt_bytes(v['bytes'])} {v['object_id'][:16]}… "
+                      f"state={v['state']}{age}{site} — {v['detail']}")
+        elif leaks:
+            print(f"{len(leaks)} leak verdict(s) "
+                  f"({_fmt_bytes(sum(v['bytes'] for v in leaks))}) — "
+                  f"rerun with --leaks for the rows")
+        for err in merged.get("errors", ()):
+            print(f"! unreachable: {err}", file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_logs(args):
     """ray parity: `ray logs` — the cluster log plane's CLI. With no
     target, prints the cluster log listing (every node agent's files).
@@ -710,6 +795,24 @@ def main(argv=None):
     p.add_argument("-o", "--output", help="write Prometheus text here")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "memory",
+        help="memory observatory: object lifecycle, arena occupancy, "
+             "leak attribution",
+    )
+    p.add_argument("--group-by",
+                   choices=["callsite", "node", "owner", "state"],
+                   help="aggregate object rows (callsite groups a "
+                        "driver-side leak by the line that made it)")
+    p.add_argument("--leaks", action="store_true",
+                   help="print every unreachable-yet-undeleted object "
+                        "row (default: a one-line count)")
+    p.add_argument("-o", "--output",
+                   help="write the full merged JSON here (chaos triage "
+                        "dumps use this)")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser(
         "logs",

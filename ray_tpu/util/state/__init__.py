@@ -32,6 +32,8 @@ __all__ = [
     "timeline",
     "train_timeline",
     "steptrace_summary",
+    "object_summary",
+    "arena_summary",
     "profile_cpu",
     "profile_memory",
     "metrics_summary",
@@ -395,6 +397,38 @@ def train_timeline(filename: Optional[str] = None) -> list:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def object_summary(group_by: Optional[str] = None,
+                   limit: Optional[int] = None) -> dict:
+    """One cluster-wide memory-observatory scrape, merged: per-object
+    lifecycle rows (state arena/external/spilled/inlined, size, owner,
+    refcount, pin count, locations, age, creation callsite), per-node
+    arena introspection, the bounded spill/restore/push/fetch flow log,
+    and leak/pressure **verdicts** (objects resident yet referenced by
+    no process, pool segments pinned by reader flocks with the pinning
+    pids, capacity overshoot attributed to its cause).
+
+    ``group_by`` ("callsite" | "node" | "owner" | "state") adds a
+    ``groups`` aggregation; ``limit`` caps the object rows returned."""
+    from ray_tpu._private import memview
+
+    merged = _gcs_request("memview_cluster", {})
+    if limit:
+        merged["objects"] = (merged.get("objects") or [])[:limit]
+    if group_by:
+        merged["groups"] = memview.group_objects(
+            merged.get("objects") or [], group_by)
+    return merged
+
+
+def arena_summary() -> List[dict]:
+    """Per-node slab-arena introspection: segment occupancy with live vs
+    dead entry counts and **dead byte ranges** (hole-punch reclamation
+    candidates), fragmentation ratio, recycling-pool and leased-vs-
+    sealed stats, per-client slab charge, pool segments pinned by reader
+    flocks (with pids), and the spill/overshoot tallies."""
+    return _gcs_request("memview_cluster", {}).get("arenas") or []
 
 
 def profile_cpu(**kwargs):

@@ -51,6 +51,18 @@ if [ "$rc" -ne 0 ]; then
     else
         echo "(no live cluster for a train timeline dump)" >&2
     fi
+    # Memory-observatory triage: object lifecycle + arena occupancy +
+    # leak/pressure verdicts from any reachable cluster — a chaos kill
+    # that stranded store bytes (dead segments, reader-flock-pinned
+    # pool entries, unreferenced objects) shows up here with its owner
+    # and creation callsite.
+    mem="${CHAOS_MEMVIEW_DUMP:-/tmp/chaos_memview.json}"
+    if timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu memory -o "$mem" >&2 2>/dev/null; then
+        echo "memory observatory dump -> $mem" >&2
+    else
+        echo "(no live cluster for a memory dump)" >&2
+    fi
     # Log-plane triage: the cluster log listing plus the last error lines
     # of the streamed worker logs — what a driver would have seen — so a
     # crashed task's final output lands next to the failing lane's report.
