@@ -512,31 +512,49 @@ def _memview_overhead_main():
 
 
 def _object_plane_main():
-    """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — put/get at
-    100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram path). Gated on
-    the structural invariant (bulk sizes slab-backed = the arena data
-    path is live, not the file fallback); throughputs are reported for
-    the BENCH_CORE A/B. Emits ONE JSON line, same contract as the
-    default bench path."""
+    """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — same-node
+    put/get at 100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram
+    path) PLUS the cross-node lane (arena-to-arena transfer plane):
+    push + pull MB/s at 64KB/1MB/64MB between two nodes of a real
+    2-node cluster. Gated on the structural invariants (bulk sizes
+    slab-backed = the arena data path is live, not the file fallback;
+    cross-node fetch/push_rx flow rows report path="arena" = receive-
+    side slab assembly is live, not the heap copy path); throughputs
+    are reported for the BENCH_CORE A/B. Emits ONE JSON line, same
+    contract as the default bench path."""
     import ray_tpu
-    from ray_tpu._private.perf import run_object_plane_bench
+    from ray_tpu._private.perf import (run_object_plane_bench,
+                                       run_transfer_plane_bench)
+    from ray_tpu.cluster_utils import Cluster
 
     small = bool(os.environ.get("BENCH_SMALL"))
-    ray_tpu.init(num_cpus=2)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    xfer_rows = []
     try:
         rows = run_object_plane_bench(small=small)
+        try:
+            xfer_rows = run_transfer_plane_bench(small=small)
+        except Exception as e:  # the local lane's numbers still count
+            print(f"[bench] transfer lane failed: {e}", file=sys.stderr)
     finally:
         ray_tpu.shutdown()
+        cluster.shutdown()
     bulk = [r for r in rows if r["bytes"] > 100 * 1024]
     one_mb = next((r for r in rows
                    if r["benchmark"] == "obj get 1MB"), {})
+    ok = (bool(bulk) and all(r["slab_backed"] for r in bulk)
+          and bool(xfer_rows)
+          and all(r["arena_paths"] for r in xfer_rows))
     print(json.dumps({
         "metric": "object_plane_get_1mb_ops_per_sec",
         "value": one_mb.get("value", 0.0),
         "unit": "ops/s",
-        "vs_baseline": 1.0 if bulk and all(r["slab_backed"] for r in bulk)
-        else 0.0,
-        "detail": rows,
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": rows + xfer_rows,
     }), flush=True)
     os._exit(0)
 

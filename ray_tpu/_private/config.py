@@ -88,8 +88,27 @@ _flag("slab_size_bytes", int, 16 * 1024 * 1024)  # default lease ceiling
 _flag("slab_min_lease_bytes", int, 1024 * 1024)  # first lease of a worker
 _flag("slab_index_slots", int, 1 << 16)  # shared index capacity (~4MB)
 _flag("object_transfer_chunk_bytes", int, 8 * 1024 * 1024)
+# concurrent chunk requests per pull (raylet._fetch_from): one request at
+# a time is latency-bound (the reason push outran pull); the window's
+# chunks land out of order at their offsets in the reserved slab entry
+_flag("fetch_pipeline_depth", int, 4)
+# the FIRST fetch request (which discovers total size + metadata) asks
+# for at most this much: a full-size head chunk is a serial prefix the
+# pipeline can't overlap, while a small head reveals the size after a
+# fraction of a chunk and the concurrent window covers the rest
+_flag("fetch_head_chunk_bytes", int, 1 << 20)
 _flag("object_pull_timeout_s", float, 60.0)
 _flag("fetch_warn_timeout_s", float, 10.0)
+# Hole-punch reclamation (object_store.punch_holes): a periodic raylet
+# pass fallocate(PUNCH_HOLE|KEEP_SIZE)s the page-aligned interior of
+# dead entry ranges in sealed segments above the fragmentation
+# threshold, returning tmpfs pages without waiting for whole-segment
+# emptiness. KEEP_SIZE preserves the mapping, so live zero-copy readers
+# keep their views; flock-pinned and pooled segments are skipped.
+_flag("slab_punch_enabled", bool, True)
+_flag("slab_punch_interval_s", float, 30.0)
+_flag("slab_punch_min_fragmentation", float, 0.25)
+_flag("slab_punch_min_bytes", int, 1 << 20)
 # Pull admission + spilling (ray: pull_manager.h:56, local_object_manager.h:40)
 _flag("max_concurrent_pulls", int, 8)
 _flag("pull_manager_memory_fraction", float, 0.5)

@@ -212,9 +212,11 @@ def puts_table() -> Dict[bytes, tuple]:
 def record_flow(kind: str, nbytes: int, dur_s: float, path: str,
                 oid_hex: Optional[str] = None):
     """One object-plane transfer event. ``kind`` is spill/restore/
-    fetch/push/push_rx; ``path`` is where the bytes travelled: "arena"
-    (zero-copy out of slab memory), "heap" (chunk assembly through heap
-    buffers), "file" (one-file .obj interop)."""
+    fetch/push/push_rx/punch; ``path`` is where the bytes travelled:
+    "arena" (bytes never left slab memory — zero-copy sends, receive-
+    side slab assembly, hole punches), "heap" (chunk assembly through
+    heap buffers: the legacy/native-fallback receive path), "file"
+    (one-file .obj interop)."""
     global _events, _flow_idx
     if not _enabled:
         return
@@ -268,8 +270,9 @@ def process_snapshot(extra: Optional[Dict[str, Any]] = None
 def coalesce_ranges(ranges: Iterable[Tuple[int, int]]
                     ) -> List[Tuple[int, int]]:
     """Merge (offset, length) ranges into sorted, maximal runs — the
-    shape a future ``fallocate(PUNCH_HOLE)`` pass would punch. Adjacent
-    and overlapping ranges fuse; order of the input doesn't matter."""
+    shape the hole-punch pass (``object_store.punch_holes``) punches.
+    Adjacent and overlapping ranges fuse; order of the input doesn't
+    matter."""
     out: List[List[int]] = []
     for off, length in sorted(ranges):
         if length <= 0:

@@ -105,6 +105,90 @@ def run_object_plane_bench(small: bool = False) -> List[dict]:
     return results
 
 
+def run_transfer_plane_bench(small: bool = False) -> List[dict]:
+    """Cross-node transfer lane (arena-to-arena plane): push and pull
+    MB/s at 128KB / 1MB / 64MB (8MB in --small/CI mode) between two
+    live nodes — 128KB, not 64KB, because anything at or under the
+    100KB inline threshold rides task specs / the owner's memory store
+    and never touches the transfer plane — p50/p95/p99 per op, plus
+    the structural invariant rows ride
+    on: on a slab-backed store every cross-node ``fetch`` / ``push_rx``
+    flow row must report ``path="arena"`` (receive-side slab assembly —
+    heap rows mean the copy path silently came back). Requires an
+    initialized cluster with >= 2 alive nodes; each round moves a FRESH
+    object so the push dedup / local-copy short-circuits never hide the
+    transfer."""
+    import ray_tpu
+    from ray_tpu.util import state
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+    from ray_tpu.util.transfer import push_object
+
+    me = ray_tpu.get_runtime_context().get_node_id()
+    peers = [n["node_id"] for n in ray_tpu.nodes()
+             if n["alive"] and n["node_id"] != me]
+    if not peers:
+        raise RuntimeError(
+            "run_transfer_plane_bench needs a second alive node"
+        )
+    peer = peers[0]
+
+    @ray_tpu.remote
+    def _fetch(r):
+        return r.nbytes
+
+    big = ("8MB", 8 << 20, 4) if small else ("64MB", 64 << 20, 6)
+    sizes = [
+        # smallest store-backed size: anything <= the 100KB inline
+        # threshold rides the owner's memory store / task specs and
+        # never touches the transfer plane at all
+        ("128KB", 128 * 1024, 10 if small else 30),
+        ("1MB", 1 << 20, 8 if small else 20),
+        big,
+    ]
+    results: List[dict] = []
+    for name, size, iters in sizes:
+        for op in ("push", "pull"):
+            h = _lat_hist()
+            best = 0.0
+            for i in range(iters):
+                arr = np.full(size, (i * 7 + len(name)) % 251, np.uint8)
+                ref = ray_tpu.put(arr)
+                t0 = time.perf_counter()
+                if op == "push":
+                    ok = push_object(ref, [peer]) == 1
+                else:
+                    ok = ray_tpu.get(_fetch.options(
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            peer)
+                    ).remote(ref), timeout=120) == size
+                dt = time.perf_counter() - t0
+                assert ok, (op, name, i)
+                h.record(dt)
+                best = max(best, size / dt / 1e6)
+                del ref
+            row = {"benchmark": f"xfer {op} {name}", "value": round(best, 2),
+                   "unit": "MB/s", "bytes": size}
+            row.update(_lat_summary(h))
+            results.append(row)
+    # structural invariant: the flow log's receive rows name their path
+    time.sleep(0.5)  # let the last push_rx row land in the remote ring
+    flows = state.object_summary().get("flows") or []
+    rx = [f for f in flows if f.get("kind") in ("fetch", "push_rx")]
+    arena_paths = bool(rx) and all(f.get("path") == "arena" for f in rx)
+    from ray_tpu._private.worker import global_worker
+
+    slab = bool(getattr(global_worker.core_worker, "arena_enabled", False))
+    for row in results:
+        row["arena_paths"] = arena_paths
+        row["slab_backed"] = slab
+        print(f"{row['benchmark']:<16s} {row['value']:>10,.1f} MB/s  "  # lint: allow-print
+              f"p50={row['p50_us']:,.0f}us p95={row['p95_us']:,.0f}us "
+              f"p99={row['p99_us']:,.0f}us arena={arena_paths}")
+    return results
+
+
 def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
     """Run the suite against an initialized ray_tpu cluster. ``select``
     substring-filters benchmark names; ``small`` shrinks batch sizes (CI)."""

@@ -541,6 +541,14 @@ class Raylet:
             gauge("slab_arena_fragmentation_ratio",
                   "dead / (live + dead) resident slab bytes",
                   st.arena_fragmentation)
+        if hasattr(st, "arena_punched_bytes"):
+            # cumulative punch-pass yield: *_total counter semantics so
+            # rate() shows reclamation activity on the cluster scrape
+            reg.counter(
+                "slab_arena_punched_dead_bytes_total",
+                "Dead bytes retired from live segments by the "
+                "hole-punch reclamation pass",
+            ).labels(**tags).set_fn(st.arena_punched_bytes)
         if hasattr(st, "pool_pinned"):
             # TTL-cached: a flock probe per pooled file per scrape is
             # cheap, but metrics scrapes can arrive from several pollers
@@ -597,6 +605,8 @@ class Raylet:
         self._tasks.append(
             spawn(self._log_tailer_loop())
         )
+        if hasattr(self.store, "punch_holes"):
+            self._tasks.append(spawn(self._punch_loop()))
         if cfg.enable_node_agent:
             spawn(self._start_agent())
         if cfg.worker_prestart > 0:
@@ -999,6 +1009,34 @@ class Raylet:
             except Exception:
                 pass
             await asyncio.sleep(cfg.heartbeat_interval_s)
+
+    async def _punch_loop(self):
+        """Periodic hole-punch reclamation: walk the arena's dead entry
+        ranges (the memory observatory's ``dead_ranges`` — PR 12 shipped
+        the measurement basis, this pass consumes it) and
+        fallocate(PUNCH_HOLE|KEEP_SIZE) page-aligned interiors of
+        fragmented sealed segments, returning tmpfs pages without
+        waiting for whole-segment emptiness. Runs on an executor thread:
+        the pass holds the store lock over flock probes + file ops."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(cfg.slab_punch_interval_s)
+            if not cfg.slab_punch_enabled:
+                continue
+            try:
+                out = await loop.run_in_executor(None,
+                                                 self.store.punch_holes)
+                if out.get("dead_bytes_retired"):
+                    logger.info(
+                        "hole-punch pass reclaimed %d dead bytes "
+                        "(%d ranges in %d segment(s), %d punched; "
+                        "%d pinned segment(s) skipped)",
+                        out["dead_bytes_retired"], out["punched_ranges"],
+                        out["segments"], out["punched_bytes"],
+                        out["skipped_pinned"],
+                    )
+            except Exception:
+                logger.exception("hole-punch pass failed")
 
     # ------------------------------------------------------------------
     # cluster view sync
@@ -2269,7 +2307,10 @@ class Raylet:
                 return True
             for node_id in locs:
                 peer = await self._peer(node_id)
-                if peer is not None and await self._fetch_from(peer, oid):
+                info = self.cluster_view.get(node_id)
+                same_host = info is not None and info.host == self.host
+                if peer is not None and await self._fetch_from(
+                        peer, oid, same_host=same_host):
                     self.counters["objects_pulled"] += 1
                     if node_id in owner_locs:
                         self.counters["owner_location_hits"] = (
@@ -2347,12 +2388,29 @@ class Raylet:
         except Exception:
             return {"locations": []}
 
-    async def _fetch_from(self, peer: Connection, oid: ObjectID) -> bool:
+    async def _fetch_from(self, peer: Connection, oid: ObjectID,
+                          same_host: bool = False) -> bool:
+        """Pull one object from a peer: the first chunk reveals the total
+        size and metadata; the rest are fetched through a bounded window
+        of CONCURRENT chunk requests (a serial chunk loop is latency-
+        bound — the reason push used to outrun pull) that land
+        out-of-order at their offsets. With a slab-backed store the
+        chunks pwrite straight into a reserved unsealed arena entry
+        (receive-side slab assembly: no heap staging, no store-put copy)
+        sealed by the atomic state-word flip only when every byte has
+        arrived; otherwise they assemble in heap buffers as before.
+
+        ``same_host`` collapses the request window to 1: loopback peers
+        have no RTT to hide, so concurrent frames on one connection only
+        contend for CPU — the net-read-overlaps-pwrite pipelining below
+        still applies (the measured win on single-host clusters)."""
         chunk = cfg.object_transfer_chunk_bytes
+        head = max(1, min(chunk, cfg.fetch_head_chunk_bytes))
         t0 = time.perf_counter()
         try:
             first = await peer.request(
-                "fetch_object", {"object_id": oid.binary(), "offset": 0, "chunk": chunk},
+                "fetch_object",
+                {"object_id": oid.binary(), "offset": 0, "chunk": head},
                 timeout=cfg.gcs_rpc_timeout_s,
             )
         except Exception:
@@ -2364,33 +2422,103 @@ class Raylet:
         # Byte-budget admission: now that the size is known, reserve it so
         # concurrent pulls cannot together overrun the transfer budget.
         await self._pull_gate.charge(total)
+        res = None
+        sealed = False
         try:
-            parts = [first["data"]]
-            got = len(first["data"])
-            while got < total:
-                try:
-                    nxt = await peer.request(
-                        "fetch_object",
-                        {"object_id": oid.binary(), "offset": got, "chunk": chunk},
-                        timeout=cfg.gcs_rpc_timeout_s,
-                    )
-                except Exception:
+            data0 = first["data"]
+            reserve = getattr(self.store, "reserve", None)
+            if reserve is not None:
+                res = reserve(oid, metadata, total)
+            parts: Optional[dict] = None if res is not None else {}
+            received = [0]
+            failed = [False]
+            loop = asyncio.get_running_loop()
+            land_lock = asyncio.Lock()
+
+            async def land(off, data):
+                if res is not None:
+                    # pwrite on an executor thread (os.pwrite drops the
+                    # GIL): the event loop keeps decoding the next
+                    # in-flight chunk's frame while this one lands —
+                    # without this, chunk writes serialize behind frame
+                    # reads and the pipeline buys nothing. Landings are
+                    # SERIALIZED with each other (one pwrite at a time):
+                    # parallel multi-MB pwrites just fight the socket
+                    # reads for memory bandwidth
+                    async with land_lock:
+                        await loop.run_in_executor(None, res.write, off,
+                                                   data)
+                else:
+                    parts[off] = data
+                received[0] += len(data)
+
+            try:
+                await land(0, data0)
+            except (ValueError, OSError):
+                # same contract as the per-chunk guard in fetch_one: an
+                # arena-landing failure (ENOSPC at first touch) fails
+                # THIS attempt — the finally abandons the reservation,
+                # and the retry's reserve() degrades to heap assembly
+                return False
+            if received[0] < total:
+                depth = 1 if same_host else cfg.fetch_pipeline_depth
+                sem = asyncio.Semaphore(max(1, depth))
+
+                async def fetch_one(off):
+                    try:
+                        nxt = await peer.request(
+                            "fetch_object",
+                            {"object_id": oid.binary(), "offset": off,
+                             "chunk": chunk},
+                            timeout=cfg.gcs_rpc_timeout_s,
+                        )
+                        data = nxt["data"] if nxt.get("exists") else None
+                    except Exception:
+                        data = None
+                    finally:
+                        # the slot guards NETWORK in-flight only: freeing
+                        # it at arrival lets the next chunk's socket read
+                        # overlap this chunk's pwrite (the landing queue
+                        # stays ~1 deep — pwrite outruns the wire)
+                        sem.release()
+                    if data is None or len(data) != min(chunk, total - off):
+                        failed[0] = True
+                        return
+                    try:
+                        await land(off, data)
+                    except (ValueError, OSError):
+                        failed[0] = True
+
+                pending = []
+                for off in range(len(data0), total, chunk):
+                    await sem.acquire()
+                    if failed[0]:
+                        sem.release()
+                        break  # stop issuing into a failed transfer
+                    pending.append(spawn(fetch_one(off)))
+                await asyncio.gather(*pending, return_exceptions=True)
+            if failed[0] or received[0] != total:
+                return False
+            if res is not None:
+                sealed = res.seal()
+                if not sealed:
                     return False
-                if not nxt.get("exists"):
-                    return False
-                parts.append(nxt["data"])
-                got += len(nxt["data"])
-            self.store.put(oid, metadata, parts, total)
-            # "heap": chunks assembled through heap buffers before the
-            # store put — the copy receive-side slab assembly (ROADMAP)
-            # will remove; the flow log is its measurement basis
+                path = "arena"
+            else:
+                self.store.put(oid, metadata,
+                               [parts[k] for k in sorted(parts)], total)
+                # "heap": chunks staged through heap buffers before the
+                # store-put copy (legacy/native fallback only)
+                path = "heap"
             from ray_tpu._private import memview
 
             memview.record_flow("fetch", total,
-                                time.perf_counter() - t0, "heap",
+                                time.perf_counter() - t0, path,
                                 oid.hex())
             return True
         finally:
+            if res is not None and not sealed:
+                res.abandon()
             self._pull_gate.uncharge(total)
 
     # ------------------------------------------------------------------
@@ -2508,20 +2636,42 @@ class Raylet:
         finally:
             buf.release()
 
+    def _drop_push_rx(self, key, st: dict):
+        """Retire one push-rx session: return its byte charge AND
+        discard its partially-written slab reservation (tombstoned dead,
+        uncharged) — an abandoned session must not leak an unsealed
+        entry eroding arena capacity until restart."""
+        self._push_rx.pop(key, None)
+        res = st.get("res")
+        if res is not None:
+            try:
+                res.abandon()
+            except Exception:
+                logger.exception("push-rx reservation abandon failed")
+        self._pull_gate.uncharge(st["total"])
+
     def _expire_push_rx(self, now: float):
         """Drop abandoned assemblies (sender died mid-push) and return
         their byte charges to the transfer budget."""
         for k, st in list(self._push_rx.items()):
             if now - st["ts"] > cfg.push_rx_expiry_s:
-                self._push_rx.pop(k, None)
-                self._pull_gate.uncharge(st["total"])
+                self._drop_push_rx(k, st)
 
     async def rpc_push_chunks(self, conn: Connection, p):
         """Receiver side: assemble out-of-order chunks of ONE push session
         (keyed by (object, push_id) so concurrent senders never interleave);
         finalize into the store and register the location when complete.
         Inbound bytes charge the same transfer budget as pulls — blocking
-        here backpressures the sender through its chunk pipeline."""
+        here backpressures the sender through its chunk pipeline.
+
+        Receive-side slab assembly: once the metadata-bearing chunk
+        (offset 0) has arrived — the entry layout is [HDR][meta][data],
+        so data offsets need the metadata length — the session reserves
+        an unsealed slab entry and every chunk pwrites straight into the
+        segment at its offset; the seal is the same atomic state-word
+        flip a local put uses, performed only when all bytes arrived.
+        Chunks that beat the metadata chunk stage in heap briefly and
+        flush into the reservation when it exists."""
         oid = ObjectID(p["object_id"])
         if self.store.contains(oid):
             # drop any in-progress assembly of this object (e.g. a slower
@@ -2529,8 +2679,7 @@ class Raylet:
             # rather than stranding it until the expiry sweep
             for k, st in list(self._push_rx.items()):
                 if k[0] == oid.binary():
-                    self._push_rx.pop(k, None)
-                    self._pull_gate.uncharge(st["total"])
+                    self._drop_push_rx(k, st)
             return {"have": True}
         now = time.monotonic()
         self._expire_push_rx(now)
@@ -2550,25 +2699,62 @@ class Raylet:
             else:
                 st = self._push_rx[key] = {
                     "parts": {}, "meta": None, "total": p["total"],
-                    "ts": now, "t0": now,
+                    "ts": now, "t0": now, "res": None, "heap": False,
+                    "got": 0, "seen": set(),
                 }
         st["ts"] = now
-        st["parts"][p["offset"]] = p["data"]
         if p.get("metadata") is not None:
             st["meta"] = p["metadata"]
-        got = sum(len(d) for d in st["parts"].values())
-        if got >= st["total"]:
-            parts = [st["parts"][k] for k in sorted(st["parts"])]
-            if not self.store.contains(oid):
-                self.store.put(oid, st["meta"], parts, st["total"])
+        if st["res"] is None and not st["heap"] and st["meta"] is not None:
+            reserve = getattr(self.store, "reserve", None)
+            if reserve is not None:
+                st["res"] = reserve(oid, st["meta"], st["total"])
+            if st["res"] is None:
+                st["heap"] = True  # fall back for the session's lifetime
+            else:
+                try:
+                    for off, d in st["parts"].items():
+                        st["res"].write(off, d)
+                except (ValueError, OSError):
+                    # same contract as the per-chunk guard below: a bad
+                    # offset / ENOSPC must retire the session (tombstone
+                    # + uncharge) instead of leaking it until expiry
+                    self._drop_push_rx(key, st)
+                    return {"ok": False}
+                st["parts"] = {}
+        if p["offset"] not in st["seen"]:
+            st["seen"].add(p["offset"])
+            st["got"] += len(p["data"])
+            if st["res"] is not None:
+                try:
+                    st["res"].write(p["offset"], p["data"])
+                except (ValueError, OSError):
+                    self._drop_push_rx(key, st)
+                    return {"ok": False}
+            else:
+                st["parts"][p["offset"]] = p["data"]
+        if st["got"] >= st["total"]:
             self._push_rx.pop(key, None)
+            if st["res"] is not None:
+                path = "arena"
+                ok = st["res"].seal()
+                if not ok:
+                    self._pull_gate.uncharge(st["total"])
+                    # a racing session's seal winning the ledger is a
+                    # successful landing from the sender's viewpoint
+                    if self.store.contains(oid):
+                        return {"have": True}
+                    return {"ok": False}
+            else:
+                path = "heap"
+                parts = [st["parts"][k] for k in sorted(st["parts"])]
+                if not self.store.contains(oid):
+                    self.store.put(oid, st["meta"], parts, st["total"])
             self._pull_gate.uncharge(st["total"])
             from ray_tpu._private import memview
 
-            # receive side assembles through heap chunk buffers today —
-            # flagged "heap" so receive-side slab assembly can A/B
             memview.record_flow("push_rx", st["total"],
-                                now - st.get("t0", now), "heap",
+                                now - st.get("t0", now), path,
                                 oid.hex())
             # unblock local pull waiters and register the new copy
             fut = self._pulls_inflight.get(oid.binary())

@@ -138,6 +138,20 @@ def _pack_header(oid: bytes, meta_len: int, data_len: int) -> bytes:
 PWRITE_MIN = 256 * 1024
 
 
+def pwrite_all(fd: int, buf, pos: int):
+    """pwrite to completion: a single pwrite caps at ~2GiB on Linux and
+    partial writes are legal — the one authoritative loop for every
+    slab write path (bulk put payloads, receive-side chunk landings)."""
+    if not isinstance(buf, memoryview):
+        buf = memoryview(buf)
+    if buf.ndim != 1 or buf.format != "B":
+        buf = buf.cast("B")
+    n = buf.nbytes
+    written = 0
+    while written < n:
+        written += os.pwrite(fd, buf[written:], pos + written)
+
+
 def write_entry(mv: memoryview, off: int, oid: bytes, metadata: bytes,
                 buffers: Iterable, fd: Optional[int] = None) -> int:
     """Write one entry into a writable segment view and SEAL it (state
@@ -155,13 +169,9 @@ def write_entry(mv: memoryview, off: int, oid: bytes, metadata: bytes,
             buf = buf.cast("B")
         n = buf.nbytes if isinstance(buf, memoryview) else len(buf)
         if fd is not None and n >= PWRITE_MIN:
-            # pwrite may write fewer bytes than asked (Linux caps a single
-            # call at ~2GiB); loop to completion or the entry seals with
-            # data_len covering a zero-filled tail
-            src = buf if isinstance(buf, memoryview) else memoryview(buf)
-            written = 0
-            while written < n:
-                written += os.pwrite(fd, src[written:], pos + written)
+            # without the completion loop the entry seals with data_len
+            # covering a zero-filled tail
+            pwrite_all(fd, buf, pos)
         else:
             mv[pos : pos + n] = buf
         pos += n
@@ -257,6 +267,81 @@ def scan_segment(path: str):
             mm.close()
         except BufferError:
             pass
+
+
+# ----------------------------------------------------------------------
+# hole-punch reclamation (fallocate PUNCH_HOLE|KEEP_SIZE)
+# ----------------------------------------------------------------------
+
+PAGE = mmap.PAGESIZE
+FALLOC_FL_KEEP_SIZE = 0x01
+FALLOC_FL_PUNCH_HOLE = 0x02
+
+_libc = None
+_punch_broken = False  # sticky: first EOPNOTSUPP/ENOSYS disables the pass
+
+
+def punch_span(off: int, length: int, page: int = PAGE
+               ) -> Optional[Tuple[int, int]]:
+    """The page-aligned interior of a dead range ``[off, off+length)``
+    that can be hole-punched while PRESERVING the entry header at
+    ``off`` — scans must still traverse the range via its (tombstone)
+    header, so the first HDR bytes never go inside the hole. Returns
+    ``(start, nbytes)`` or None when no whole page fits."""
+    start = (off + HDR + page - 1) // page * page
+    end = (off + length) // page * page
+    if end <= start:
+        return None
+    return start, end - start
+
+
+def punch_range(fd: int, start: int, nbytes: int) -> bool:
+    """fallocate(PUNCH_HOLE | KEEP_SIZE) one range: the file size and
+    every existing mapping stay intact (readers keep valid views — the
+    punched pages read back as zeros), the backing tmpfs pages are
+    freed. Returns False (sticky, process-wide) where unsupported."""
+    global _libc, _punch_broken
+    if _punch_broken or nbytes <= 0:
+        return False
+    try:
+        import ctypes
+
+        if _libc is None:
+            lib = ctypes.CDLL(None, use_errno=True)
+            lib.fallocate.argtypes = [ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_longlong, ctypes.c_longlong]
+            lib.fallocate.restype = ctypes.c_int
+            _libc = lib
+        if _libc.fallocate(
+            fd, FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE, start, nbytes
+        ) != 0:
+            import errno
+
+            if ctypes.get_errno() in (errno.EOPNOTSUPP, errno.ENOSYS):
+                _punch_broken = True
+            return False
+        return True
+    except (OSError, AttributeError, TypeError):
+        _punch_broken = True
+        return False
+
+
+def write_dead_tombstone(fd: int, off: int, total: int) -> bool:
+    """Overwrite the entry header at ``off`` with a DEAD header whose
+    entry_total covers the whole ``total``-byte (coalesced, entry-
+    aligned) range, so a scan hops the punched range in ONE step —
+    interior entries' headers are about to be zeroed by the punch, and
+    without the covering tombstone the scan would stop at the first
+    zeroed state word and strand every sealed entry behind it."""
+    if total < align_up(HDR):
+        return False
+    hdr = _pack_header(b"\0" * OID_SIZE, 0, total - HDR)
+    try:
+        os.pwrite(fd, hdr[: HDR - 8], off + 8)
+        os.pwrite(fd, STATE_DEAD, off)
+        return True
+    except OSError:
+        return False
 
 
 def mark_dead_at(store_dir: str, seg_id: int, off: int) -> bool:
@@ -402,13 +487,21 @@ class _ArenaView:
     a cached mapping keeps the pages alive even after the owner unlinks
     the segment file)."""
 
+    # a cached mapping (and its reader flock) unused this long is closed
+    # on the next cache access: a long-lived driver that once read from
+    # a segment must not pin it against hole-punch reclamation forever —
+    # live exported views still protect their mapping (BufferError)
+    IDLE_CLOSE_S = 15.0
+
     def __init__(self, store_dir: str, cache_segments: int = 64):
         self.store_dir = store_dir
         self.lock = threading.Lock()
         self.index: Optional[SharedIndex] = None
-        self.segs: "OrderedDict[int, Tuple[mmap.mmap, int]]" = OrderedDict()
+        # seg_id -> (mm, size, file, [last_used_monotonic])
+        self.segs: "OrderedDict[int, tuple]" = OrderedDict()
         self.cache_segments = cache_segments
         self._index_miss_until = 0.0
+        self._idle_sweep_at = 0.0
 
     def _index(self) -> Optional[SharedIndex]:
         if self.index is not None:
@@ -431,9 +524,16 @@ class _ArenaView:
         return self.index
 
     def segment(self, seg_id: int) -> Optional[Tuple[mmap.mmap, int]]:
+        import time as _time
+
+        now = _time.monotonic()
         with self.lock:
+            if now - self._idle_sweep_at > self.IDLE_CLOSE_S / 3:
+                self._idle_sweep_at = now
+                self._sweep_idle_locked(now)
             ent = self.segs.get(seg_id)
             if ent is not None:
+                ent[3][0] = now
                 self.segs.move_to_end(seg_id)
                 return ent[0], ent[1]
         path = segment_path(self.store_dir, seg_id)
@@ -458,7 +558,9 @@ class _ArenaView:
         # the flock fd must outlive every exported view of the mapping
         # (a recycled-while-viewed segment would be a torn read)
         weakref.finalize(mm, f.close)
-        ent = (mm, size, f)
+        import time as _time
+
+        ent = (mm, size, f, [_time.monotonic()])
         with self.lock:
             won = self.segs.setdefault(seg_id, ent)
             if won is not ent:
@@ -472,12 +574,29 @@ class _ArenaView:
 
     @staticmethod
     def _close_entry(ent):
-        mm, _sz, f = ent
+        mm, _sz, f = ent[:3]
         try:
             mm.close()
         except BufferError:
             return  # views alive: the finalize closes f when they die
         f.close()
+
+    def _sweep_idle_locked(self, now: float):
+        """Close cached mappings unused for IDLE_CLOSE_S whose views are
+        all gone — the reader flock goes with the mapping, releasing the
+        segment for the owner's hole-punch / recycle passes. Read paths
+        retry once on a concurrently-swept mapping (ValueError), so a
+        sweep can never turn a live object into a miss."""
+        for sid in list(self.segs.keys()):
+            ent = self.segs[sid]
+            if now - ent[3][0] < self.IDLE_CLOSE_S:
+                continue
+            try:
+                ent[0].close()
+            except BufferError:
+                continue  # exported views keep it (and its flock) alive
+            ent[2].close()
+            del self.segs[sid]
 
     def _sweep_locked(self):
         """Drop cached mappings of segments the owner has unlinked or
@@ -500,6 +619,23 @@ class _ArenaView:
     def sweep(self):
         with self.lock:
             self._sweep_locked()
+
+    def drop_segment(self, seg_id: int) -> bool:
+        """Release OUR cached mapping of one segment (and its SHARED
+        flock) so the owner's hole-punch pass can prove no process views
+        it. Returns False when exported zero-copy views keep the mapping
+        alive — the punch pass then skips the segment."""
+        with self.lock:
+            ent = self.segs.get(seg_id)
+            if ent is None:
+                return True
+            try:
+                ent[0].close()
+            except BufferError:
+                return False  # live exported views: segment stays pinned
+            ent[2].close()
+            del self.segs[seg_id]
+            return True
 
     def resolve(self, oid: bytes) -> Optional[Tuple[int, int, mmap.mmap, int]]:
         idx = self._index()
@@ -551,58 +687,68 @@ def read(store_dir: str, oid: bytes
          ) -> Optional[Tuple[bytes, memoryview, int]]:
     """(metadata, zero-copy data view, seg_id) via the shared index, or
     None. Flock-free: validation is the in-slab sealed header."""
-    r = view(store_dir).resolve(oid)
-    if r is None:
-        return None
-    seg_id, off, mm, size = r
-    try:
-        got = read_entry_at(mm, off, size, oid=oid)
-    except ValueError:
-        # cache race: a concurrent sweep/LRU eviction closed this
-        # viewless mapping between resolve and the slice — a miss, not
-        # an error (the caller's pull path reopens the segment)
-        return None
-    if got is None:
-        return None
-    metadata, data, _total = got
-    return metadata, data, seg_id
+    for _ in range(2):
+        r = view(store_dir).resolve(oid)
+        if r is None:
+            return None
+        seg_id, off, mm, size = r
+        try:
+            got = read_entry_at(mm, off, size, oid=oid)
+        except ValueError:
+            # cache race: a concurrent sweep (idle-close, LRU) closed
+            # this viewless mapping between resolve and the slice —
+            # resolve again (it re-opens), never report a live object
+            # as a miss off a swept mapping
+            continue
+        if got is None:
+            return None
+        metadata, data, _total = got
+        return metadata, data, seg_id
+    return None
+
 
 def read_at(store_dir: str, seg_id: int, off: int, oid: bytes
             ) -> Optional[Tuple[bytes, memoryview]]:
     """Ledger-directed read (owner side / RPC-resolved): skip the index."""
-    ent = view(store_dir).segment(seg_id)
-    if ent is None:
-        return None
-    mm, size = ent
-    try:
-        got = read_entry_at(mm, off, size, oid=oid)
-    except ValueError:
-        return None  # mapping closed by a concurrent sweep: miss
-    if got is None:
-        return None
-    return got[0], got[1]
+    for _ in range(2):
+        ent = view(store_dir).segment(seg_id)
+        if ent is None:
+            return None
+        mm, size = ent
+        try:
+            got = read_entry_at(mm, off, size, oid=oid)
+        except ValueError:
+            continue  # swept under us: re-open and retry once
+        if got is None:
+            return None
+        return got[0], got[1]
+    return None
 
 
 def exists(store_dir: str, oid: bytes) -> bool:
-    r = view(store_dir).resolve(oid)
-    if r is None:
-        return False
-    seg_id, off, mm, size = r
-    try:
-        return entry_state_at(mm, off, size, oid=oid) == STATE_SEALED
-    except ValueError:
-        return False  # mapping closed by a concurrent sweep: miss
+    for _ in range(2):
+        r = view(store_dir).resolve(oid)
+        if r is None:
+            return False
+        seg_id, off, mm, size = r
+        try:
+            return entry_state_at(mm, off, size, oid=oid) == STATE_SEALED
+        except ValueError:
+            continue  # swept under us: re-open and retry once
+    return False
 
 
 def state_at(store_dir: str, seg_id: int, off: int, oid: bytes) -> Optional[bytes]:
-    ent = view(store_dir).segment(seg_id)
-    if ent is None:
-        return None
-    mm, size = ent
-    try:
-        return entry_state_at(mm, off, size, oid=oid)
-    except ValueError:
-        return None  # mapping closed by a concurrent sweep
+    for _ in range(2):
+        ent = view(store_dir).segment(seg_id)
+        if ent is None:
+            return None
+        mm, size = ent
+        try:
+            return entry_state_at(mm, off, size, oid=oid)
+        except ValueError:
+            continue  # swept under us: re-open and retry once
+    return None
 
 
 def discard(store_dir: str, oid: bytes) -> bool:
@@ -720,6 +866,25 @@ class SlabWriter:
         memory."""
         nxt = min(slab_default, max(slab_min, self._last_lease * 2))
         return max(entry_total, nxt)
+
+    def try_reserve(self, entry_total: int) -> Optional[Tuple[int, int]]:
+        """Bump-allocate one entry range WITHOUT writing it: the caller
+        (receive-side slab assembly) pwrites chunk payloads straight into
+        the segment file at their offsets and seals with the same
+        state-word flip ``write_entry`` uses. Until that seal the entry
+        reads as torn — a receiver killed mid-transfer leaves exactly
+        the tail a crash rescan already discards. Returns
+        ``(seg_id, off)`` or None when the current slab can't fit it."""
+        with self.lock:
+            if self._mm is None or self._off + entry_total > self._size:
+                return None
+            off = self._off
+            self._off += entry_total
+            # recycled pooled segments are only state-wiped at their OLD
+            # entry offsets: scrub our new entry's state word so a stale
+            # sealed magic can never make the in-progress entry scannable
+            self._mv[off : off + 8] = b"\0" * 8
+            return self.seg_id, off
 
     def try_put(self, oid: bytes, metadata: bytes, buffers,
                 total_data_len: int) -> Optional[dict]:

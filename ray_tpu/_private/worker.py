@@ -1895,16 +1895,20 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # objects: slab-arena write path (slab_arena.py)
     # ------------------------------------------------------------------
-    def store_put(self, oid: ObjectID, sv: serialization.SerializedValue):
+    def store_put(self, oid: ObjectID, sv: serialization.SerializedValue,
+                  callsite: Optional[str] = None):
         """Store a serialized value (> inline threshold) into the node
         object plane. Slab arena when this client holds or can lease a
         write slab: bump-allocate + seal + shared-index publish, with
         accounting batched to the raylet (no per-put RPC). One-file
         fallback otherwise — and on the io-loop thread when the slab is
         full (a refill RPC must never block the loop that sends it);
-        the refill then runs in the background for the next put."""
+        the refill then runs in the background for the next put.
+        ``callsite`` (the creating user line) rides the slab report into
+        the store-side ledger so leak verdicts survive this owner's
+        death."""
         t0 = time.perf_counter()
-        if self._arena_put(oid, sv):
+        if self._arena_put(oid, sv, callsite):
             mx = object_store._mx()
             mx.put_lat.record(time.perf_counter() - t0)
             mx.put_bytes.record(sv.total_data_len)
@@ -1916,34 +1920,38 @@ class CoreWorker:
         self._register_put_fallback(oid)
 
     def _slab_try_put(self, oid: ObjectID,
-                      sv: serialization.SerializedValue) -> bool:
+                      sv: serialization.SerializedValue,
+                      callsite: Optional[str] = None) -> bool:
         ent = self._slab_writer.try_put(
             oid.binary(), sv.metadata, sv.buffers, sv.total_data_len
         )
         if ent is None:
             return False
+        if callsite:
+            ent["c"] = callsite
         self._queue_slab_report(ent)
         return True
 
     def _arena_put(self, oid: ObjectID,
-                   sv: serialization.SerializedValue) -> bool:
+                   sv: serialization.SerializedValue,
+                   callsite: Optional[str] = None) -> bool:
         if self._slab_writer is None:
             return False
-        if self._slab_try_put(oid, sv):
+        if self._slab_try_put(oid, sv, callsite):
             return True
         need = slab_arena.entry_size(len(sv.metadata), sv.total_data_len)
         if threading.current_thread() is self.io.thread:
             self._kick_slab_refill(need)
             return False
         with self._slab_lease_lock:
-            if self._slab_try_put(oid, sv):  # a racing refill already won
-                return True
+            if self._slab_try_put(oid, sv, callsite):
+                return True  # a racing refill already won
             try:
                 ok = self.io.run(self._slab_refill(need),
                                  timeout=cfg.gcs_rpc_timeout_s * 2)
             except Exception:
                 ok = False
-            return bool(ok) and self._slab_try_put(oid, sv)
+            return bool(ok) and self._slab_try_put(oid, sv, callsite)
 
     async def _slab_refill(self, entry_total: int) -> bool:
         """Serialized refill: at most ONE lease request in flight per
@@ -2075,11 +2083,16 @@ class CoreWorker:
         oid = ObjectID.for_put(self.task_id, idx)
         # memory observatory: stamp the creating user callsite so a
         # leaked put groups by the line that made it (flag-gated; a
-        # bounded frame walk, ~1µs against a >=100µs store put)
+        # bounded frame walk, ~1µs against a >=100µs store put). The
+        # tag is computed ONCE and also handed to store_put below, which
+        # persists it into the store-side ledger — a dead owner's leak
+        # verdict then still names the line that made the object
+        callsite = memview.callsite_tag() if memview.is_enabled() else None
         memview.record_put(
             oid.binary(), sv.total_data_len,
             "inline" if sv.total_data_len
-            <= cfg.max_direct_call_object_size else "put")
+            <= cfg.max_direct_call_object_size else "put",
+            callsite=callsite)
         # Refs nested in the stored value are kept alive by this container
         # until it is freed (ray: reference_count.h AddNestedObjectIds). The
         # nested refs are live python ObjectRefs here, so their borrows are
@@ -2096,7 +2109,7 @@ class CoreWorker:
         else:
             # slab-arena write: bump+seal+index, accounting batched — no
             # blocking per-put registration round trip
-            self.store_put(oid, sv)
+            self.store_put(oid, sv, callsite=callsite)
             self._record_owned_location(oid.binary(), self.node_id)
             with self._lock:
                 self._owned.add(oid.binary())

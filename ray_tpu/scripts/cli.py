@@ -340,7 +340,8 @@ def cmd_memory(args):
                   f"({a.get('leased_segments', 0)} leased), "
                   f"live {_fmt_bytes(a.get('live_bytes'))}, "
                   f"dead {_fmt_bytes(a.get('dead_bytes'))} "
-                  f"(frag {100 * (a.get('fragmentation') or 0):.1f}%), "
+                  f"(frag {100 * (a.get('fragmentation') or 0):.1f}%, "
+                  f"punched {_fmt_bytes(a.get('punched_bytes'))}), "
                   f"pool {len(a.get('pool') or ())}{pin_note}, "
                   f"spilled {spilled.get('spilled_objects', 0)}, "
                   f"overshoot "

@@ -37,6 +37,12 @@ if [ "$rc" -ne 0 ]; then
         python -m ray_tpu metrics -o "$out" >/dev/null 2>&1; then
         echo "cluster metrics snapshot -> $out" >&2
         grep -a 'rpc_faults_injected_total' "$out" >&2 || true
+        # transfer-plane triage: dead/punched byte gauges make stuck
+        # reclamation visible, and the slab-vs-file put counters show a
+        # silent fall-off from the arena data path
+        echo "--- object-plane gauges (arena occupancy + punch yield) ---" >&2
+        grep -aE 'slab_arena_(dead|live)_bytes|slab_arena_fragmentation|slab_arena_punched|slab_punch|slab_segments_pinned|object_store_slab_rx_assemblies' \
+            "$out" >&2 || true
     else
         echo "(no live cluster to scrape)" >&2
     fi
@@ -60,6 +66,21 @@ if [ "$rc" -ne 0 ]; then
     if timeout -k 5 60 env JAX_PLATFORMS=cpu \
         python -m ray_tpu memory -o "$mem" >&2 2>/dev/null; then
         echo "memory observatory dump -> $mem" >&2
+        # transfer-path triage: cross-node fetch/push_rx flow rows name
+        # their path — "heap" rows on a slab-backed cluster mean the
+        # receive-side slab assembly regressed to the copy path
+        echo "--- transfer flow paths (arena = slab assembly, heap = copy path) ---" >&2
+        python - "$mem" >&2 <<'PYEOF' || true
+import json, sys
+from collections import Counter
+flows = (json.load(open(sys.argv[1])).get("flows") or [])
+paths = Counter((f.get("kind"), f.get("path")) for f in flows
+                if f.get("kind") in ("fetch", "push", "push_rx", "punch"))
+for (kind, path), n in sorted(paths.items()):
+    print(f"  {kind:8s} path={path:5s} x{n}")
+if not paths:
+    print("  (no transfer flow rows in the dump)")
+PYEOF
     else
         echo "(no live cluster for a memory dump)" >&2
     fi

@@ -95,3 +95,38 @@ def test_object_plane_ratio_floors(object_plane_rows):
     rt = 1.0 / (1.0 / rows["obj put 1MB"]["value"]
                 + 1.0 / rows["obj get 1MB"]["value"])
     assert rt * 2 * (1 << 20) >= 80 * (1 << 20), rows
+
+
+# ----------------------------------------------------------------------
+# cross-node transfer plane (arena-to-arena): push/pull floors between
+# two real nodes. ONE test so the 2-node cluster + bench matrix run
+# once; function-scoped own cluster — LAST in the module so the
+# shared-cluster fixtures above keep their reuse.
+# ----------------------------------------------------------------------
+
+def test_transfer_plane_arena_paths_and_floors(ray_start_cluster):
+    import ray_tpu
+    from ray_tpu._private.perf import run_transfer_plane_bench
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    rows = {r["benchmark"]: r for r in run_transfer_plane_bench(small=True)}
+    # structural invariant (receive-side slab assembly): every cross-node
+    # fetch / push_rx flow row must report path="arena" on a slab-backed
+    # store — a "heap" row means the chunk-copy path silently came back
+    for row in rows.values():
+        assert row["slab_backed"], rows
+        assert row["arena_paths"], rows
+    # SOFT floors far under healthy loopback values (hundreds of MB/s on
+    # this plane): only a structural regression — a lost zero-copy send,
+    # chunks re-serialized per hop, a serial re-fetch storm — trips them
+    assert rows["xfer pull 8MB"]["value"] >= 30, rows
+    assert rows["xfer push 8MB"]["value"] >= 30, rows
+    # bulk transfers must beat small-object transfers on bandwidth (the
+    # per-op fixed cost dominates 128KB; a flat ratio means the bulk
+    # path degenerated to per-chunk control-plane costs)
+    assert rows["xfer pull 8MB"]["value"] >= \
+        2 * rows["xfer pull 128KB"]["value"], rows
