@@ -511,6 +511,191 @@ def _memview_overhead_main():
     os._exit(0)
 
 
+def _reqtrace_overhead_main():
+    """BENCH_REQTRACE_OVERHEAD=1: the request observatory's acceptance
+    numbers on the serve proxy hot path. (a) recorder share: per-request
+    record count (spans+marks the cluster actually wrote) x calibrated
+    per-record cost, divided by the measured proxy round trip — gated
+    <2% (calibration x count estimator, same discipline as the
+    metrics/logs/steptrace/memview lanes: this box's virtualized
+    10ms-quantum CPU clocks make in-situ self-timing of sub-us slices
+    read zero). (b) off posture: with RAY_TPU_reqtrace_enabled=0 the
+    same HTTP loop must leave ZERO record attempts cluster-wide. Emits
+    ONE JSON line, same contract as the default bench path."""
+    import requests
+
+    import ray_tpu
+    from ray_tpu._private import reqtrace
+
+    # calibrate the per-record cost, uncontended
+    n_cal = 50_000
+    reqtrace.set_enabled(True)
+    reqtrace.reset()
+    t0 = time.perf_counter()
+    for i in range(n_cal):
+        reqtrace.record_span("cal0123456789ab", "execute", 0.0, 0.0,
+                             app="a", deployment="d", replica="r")
+    per_record = (time.perf_counter() - t0) / n_cal
+    reqtrace.reset()
+
+    def boot_and_measure(n_requests: int):
+        from ray_tpu import serve
+        from ray_tpu.util import state
+
+        ray_tpu.init(num_cpus=4)
+        try:
+            serve.start()
+
+            @serve.deployment(num_replicas=1)
+            def echo(request):
+                return b"ok"
+
+            serve.run(echo.bind(), name="rt_bench", route_prefix="/rt")
+            url = f"http://127.0.0.1:{serve.http_port()}/rt"
+            for _ in range(20):  # warm routes/handles/replica
+                requests.get(url, timeout=30)
+            t0 = time.perf_counter()
+            for _ in range(n_requests):
+                r = requests.get(url, timeout=30)
+                assert r.status_code == 200, r.text
+            mean_rt = (time.perf_counter() - t0) / n_requests
+            merged = state.serve_summary()
+            serve.shutdown()
+            return mean_rt, merged
+        finally:
+            ray_tpu.shutdown()
+
+    # phase 1: enabled — calibrated recorder share of a proxy round trip
+    n_on = 200
+    mean_rt, merged = boot_and_measure(n_on)
+    record_calls = merged.get("record_calls", 0)
+    records_per_req = record_calls / max(1, n_on + 20)
+    share = records_per_req * per_record / mean_rt if mean_rt else 1.0
+    # phase 2: disabled cluster-wide via the env override every spawned
+    # process inherits — the same loop must record NOTHING anywhere
+    os.environ["RAY_TPU_reqtrace_enabled"] = "0"
+    try:
+        _rt_off, merged_off = boot_and_measure(100)
+        off_records = merged_off.get("record_calls", 0)
+    finally:
+        os.environ.pop("RAY_TPU_reqtrace_enabled", None)
+
+    ok = share < 0.02 and records_per_req >= 4 and off_records == 0
+    print(json.dumps({
+        "metric": "reqtrace_overhead_recorder_fraction",
+        "value": round(share, 6),
+        "unit": "fraction",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "per_record_cost_us": round(per_record * 1e6, 3),
+            "records_per_request": round(records_per_req, 2),
+            "record_calls_on": record_calls,
+            "record_calls_off": off_records,
+            "proxy_round_trip_ms": round(mean_rt * 1e3, 3),
+        },
+    }), flush=True)
+    os._exit(0)
+
+
+def _serve_load_main():
+    """BENCH_SERVE_LOAD=1: the synthetic serve load harness — an
+    open-loop asyncio client (BENCH_SERVE_RPS offered rate,
+    BENCH_SERVE_CONNS connections, BENCH_SERVE_DURATION seconds)
+    against a real 2-replica deployment through the real proxy,
+    reporting latency + TTFT percentiles and queue-depth-over-time
+    (serve_replica_queue_depth sampled via the cluster scrape). Gated
+    on the request observatory's calibrated overhead share of the
+    measured p50 staying <2% — the A/B substrate for continuous
+    batching, zero-copy bodies, and backpressure PRs. Emits ONE JSON
+    line, same contract as the default bench path."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import metrics_core, reqtrace
+    from ray_tpu.serve.load_harness import run_load
+    from ray_tpu.util import state
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    rps = float(os.environ.get("BENCH_SERVE_RPS", "60" if small else "150"))
+    duration = float(os.environ.get("BENCH_SERVE_DURATION",
+                                    "5" if small else "10"))
+    conns = int(os.environ.get("BENCH_SERVE_CONNS", "1024"))
+
+    # calibrate the per-record cost (same estimator as the overhead lane)
+    n_cal = 20_000
+    reqtrace.set_enabled(True)
+    t0 = time.perf_counter()
+    for _ in range(n_cal):
+        reqtrace.record_span("cal0123456789ab", "execute", 0.0, 0.0,
+                             app="a", deployment="d", replica="r")
+    per_record = (time.perf_counter() - t0) / n_cal
+    reqtrace.reset()
+
+    def queue_depth() -> float:
+        """Cluster-wide sum of serve_replica_queue_depth right now."""
+        from ray_tpu.util import metrics as m
+
+        merged = m.cluster_snapshot().get("merged", {})
+        entry = metrics_core.summarize(merged).get(
+            "serve_replica_queue_depth")
+        if not entry:
+            return 0.0
+        return sum(s.get("value", 0.0) for s in entry["series"])
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=2048)
+        class Echo:
+            async def __call__(self, request):
+                import asyncio as aio
+
+                await aio.sleep(0.005)  # a little service time so
+                return b"ok"            # queueing is visible
+
+        serve.run(Echo.bind(), name="load_bench", route_prefix="/load")
+        url = f"http://127.0.0.1:{serve.http_port()}/load"
+        out = run_load(url, rps=rps, duration_s=duration,
+                       connections=conns, depth_sampler=queue_depth)
+        merged = state.serve_summary()
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    reqs = merged.get("requests") or []
+    recs_per_req = (sum(len(r.get("phases") or ())
+                        + len(r.get("marks") or {}) for r in reqs)
+                    / max(1, len(reqs)))
+    p50 = out["latency"]["p50"]
+    overhead_share = recs_per_req * per_record / p50 if p50 else 1.0
+    ok = (out["ok"] > 0 and out["errors"] <= 0.01 * out["requests"]
+          and overhead_share < 0.02)
+    print(json.dumps({
+        "metric": "serve_load_achieved_rps",
+        "value": out["achieved_rps"],
+        "unit": "req/s",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "offered_rps": rps,
+            "duration_s": duration,
+            "connections": conns,
+            "peak_inflight": out["peak_inflight"],
+            "errors": out["error_kinds"],
+            "latency_ms": {k: round(v * 1e3, 2)
+                           for k, v in out["latency"].items()
+                           if k != "count"},
+            "ttft_ms": {k: round(v * 1e3, 2)
+                        for k, v in out["ttft"].items() if k != "count"},
+            "queue_depth_series": out["queue_depth_series"],
+            "reqtrace_overhead_share": round(overhead_share, 5),
+            "records_per_request": round(recs_per_req, 2),
+            "traced_requests": len(reqs),
+            "skew_verdicts": merged.get("verdicts") or [],
+        },
+    }), flush=True)
+    os._exit(0)
+
+
 def _object_plane_main():
     """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — same-node
     put/get at 100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram
@@ -573,6 +758,10 @@ def main():
         _steptrace_overhead_main()
     if os.environ.get("BENCH_MEMVIEW_OVERHEAD"):
         _memview_overhead_main()
+    if os.environ.get("BENCH_REQTRACE_OVERHEAD"):
+        _reqtrace_overhead_main()
+    if os.environ.get("BENCH_SERVE_LOAD"):
+        _serve_load_main()
     if os.environ.get("BENCH_OBJECT_PLANE"):
         _object_plane_main()
 

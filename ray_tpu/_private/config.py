@@ -283,6 +283,20 @@ _flag("rpc_keepalive_timeout_s", float, 20.0)
 # Serve (ray: serve/_private defaults)
 _flag("serve_control_loop_period_s", float, 0.25)
 _flag("serve_default_graceful_shutdown_timeout_s", float, 5.0)
+# Handle-side routing staleness guard: replica-reported queue lengths
+# older than this are IGNORED by power-of-two-choices scoring (local
+# inflight counts only) — a wedged controller's stale snapshot must not
+# keep steering traffic at a replica that has since filled up.
+_flag("serve_replica_report_max_age_s", float, 5.0)
+# Request observatory (reqtrace.py): per-request serve phase tracing.
+# reqtrace_enabled gates every record path (zero-cost off, same posture
+# as metrics/steptrace/memview); the ring holds the newest
+# reqtrace_ring_size records per process (drop accounting rides the
+# snapshot).
+_flag("reqtrace_enabled", bool, True)
+_flag("reqtrace_ring_size", int, 8192)
+# per-node fan-out timeout inside reqtrace_cluster
+_flag("reqtrace_scrape_timeout_s", float, 10.0)
 # Tune (ray: tune/execution/experiment_state.py checkpoint period)
 _flag("tune_experiment_snapshot_period_s", float, 10.0)
 # Train (ray: train/_internal/backend_executor timeouts)

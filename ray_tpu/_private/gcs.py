@@ -166,6 +166,9 @@ class GcsServer:
         # step observatory: rolling collective-skew fold (steptrace.py),
         # built lazily on the first steptrace_cluster scrape
         self._steptrace_agg = None
+        # request observatory: rolling serve-request fold (reqtrace.py),
+        # built lazily on the first reqtrace_cluster scrape
+        self._reqtrace_agg = None
         self._recovering: Set[bytes] = set()  # actor_ids awaiting raylet reclaim
         self._recovered = self._replay()
 
@@ -1212,6 +1215,52 @@ class GcsServer:
             None, agg.fold_and_merge, processes,
             (p or {}).get("limit") or 0)
         merged["processes"] = len(processes)
+        merged["errors"] = [proc for proc in processes
+                            if proc.get("error")]
+        return merged
+
+    # ------------------------------------------------------------------
+    # Request observatory (reqtrace.py): per-request serve tracing
+    # fan-out + request-id join into phase breakdowns and skew verdicts
+    # ------------------------------------------------------------------
+    async def rpc_reqtrace_cluster(self, conn: Connection, p):
+        """One cluster-wide serve request-trace scrape: fan to every
+        live raylet (serve proxies and replicas are actors in worker
+        processes) plus registered DRIVER connections (handle-direct
+        callers record route spans driver-side), then
+
+        1. fold the NEW spans into the rolling request metrics
+           (``serve_request_phase_seconds{app,deployment,phase}`` +
+           ``serve_request_ttft_seconds``) — they live in THIS process's
+           registry, so they ride the existing /metrics cluster scrape;
+        2. join proxy+replica records by request id into per-request
+           phase breakdowns, per-deployment p50/p95/p99, per-replica
+           phase profiles, and slow-replica skew verdicts.
+
+        The merge runs over the aggregator's ACCUMULATED log, not just
+        this scrape — the request timeline survives the proxies/replicas
+        that produced it. Mirrors steptrace_cluster's posture: the fold
+        is idempotent across repeated scrapes (per-process record-index
+        high-water marks) and the CPU-bound fold+merge runs on an
+        executor thread; ?limit caps the merge for polling surfaces."""
+        from ray_tpu._private import reqtrace
+
+        processes, _ = await self._scrape_processes(
+            "reqtrace_node", "reqtrace_snapshot",
+            cfg.reqtrace_scrape_timeout_s, tag_drivers=True)
+        agg = self._reqtrace_agg
+        if agg is None:
+            agg = self._reqtrace_agg = reqtrace.RequestAggregator()
+        merged = await asyncio.get_running_loop().run_in_executor(
+            None, agg.fold_and_merge, processes,
+            (p or {}).get("limit") or 0)
+        ok = [proc for proc in processes if not proc.get("error")]
+        merged["processes"] = len(processes)
+        merged["dropped"] = sum(proc.get("dropped", 0) for proc in ok)
+        # cluster-wide record-attempt count: the overhead bench lane's
+        # zero-records-when-disabled gate reads this
+        merged["record_calls"] = sum(proc.get("record_calls", 0)
+                                     for proc in ok)
         merged["errors"] = [proc for proc in processes
                             if proc.get("error")]
         return merged

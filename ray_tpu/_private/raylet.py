@@ -3119,6 +3119,32 @@ class Raylet:
         processes = list(await asyncio.gather(*[one(w) for w in live]))
         return {"node_id": self.node_id, "processes": processes}
 
+    # -- request observatory (reqtrace.py) -----------------------------
+    async def rpc_reqtrace_node(self, conn: Connection, p):
+        """Every live worker's reqtrace ring, gathered CONCURRENTLY
+        (same posture as steptrace_node: one wedged worker must not
+        stall the scrape). Serve proxies and replicas are actors in
+        worker processes, so the node fan-out covers them; the raylet
+        itself serves no requests and contributes no snapshot."""
+        live = [
+            w for w in self.all_workers.values()
+            if w.conn is not None and not w.conn.closed
+        ]
+
+        async def one(w: _Worker):
+            try:
+                out = await w.conn.request(
+                    "reqtrace_snapshot", {},
+                    timeout=cfg.reqtrace_scrape_timeout_s)
+            except Exception as e:
+                return {"pid": w.proc.pid, "node_id": self.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+            out.setdefault("node_id", self.node_id)
+            return out
+
+        processes = list(await asyncio.gather(*[one(w) for w in live]))
+        return {"node_id": self.node_id, "processes": processes}
+
     # -- memory observatory (memview.py) -------------------------------
     async def rpc_memview_node(self, conn: Connection, p):
         """This node's object-plane view: every live worker's memview

@@ -1585,6 +1585,15 @@ class CoreWorker:
 
         return self._annotate_profile(steptrace.process_snapshot())
 
+    # -- request observatory (reqtrace.py) -----------------------------
+    async def rpc_reqtrace_snapshot(self, conn: Connection, p):
+        """This process's serve request-trace ring (phase spans + stream
+        marks) — the GCS-side merge joins these across proxy/replica
+        processes by request id into per-request phase breakdowns."""
+        from ray_tpu._private import reqtrace
+
+        return self._annotate_profile(reqtrace.process_snapshot())
+
     # -- memory observatory (memview.py) -------------------------------
     async def rpc_memview_snapshot(self, conn: Connection, p):
         """This process's object-plane view: the owned-object table
@@ -1599,8 +1608,12 @@ class CoreWorker:
             owned = list(self._owned)[:10_000]
             refs = dict(self._local_refs)
             pins = dict(self._escape_pins)
-            inlined = {oid: len(v[1]) for oid, v
-                       in self._memory_store.items()}
+            # inline values are bytes OR the zero-copy wire forms
+            # (BufferList / memoryview) — len() is wrong or absent for
+            # those; one such entry must not poison the whole snapshot
+            inlined = {oid: (v[1].nbytes if hasattr(v[1], "nbytes")
+                             else len(v[1]))
+                       for oid, v in self._memory_store.items()}
             borrows = [oid for oid, st in self._borrow_state.items()
                        if st.get("count", 0) > 0]
             contains = list(self._contains)

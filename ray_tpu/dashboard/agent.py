@@ -18,6 +18,9 @@ Routes:
   GET /api/v0/memview — node-local memory observatory (this raylet's
       store ledger + arena introspection + its workers' owner tables;
       cluster-wide leak verdicts merge at the GCS)
+  GET /api/v0/reqtrace — node-local request observatory (this raylet's
+      workers' serve trace rings; cross-process request-id joins merge
+      at the GCS)
   GET /api/v0/logs    — session log files (name, size)
   GET /api/v0/logs/tail?file=<name>&lines=N — tail one log file
   GET /api/v0/logs/range?file=<name>&start=A&end=B — exact byte range
@@ -177,6 +180,15 @@ class Agent:
         conn = await self._raylet()
         return _json(await conn.request("memview_node", {}, timeout=30))
 
+    async def reqtrace(self, request):
+        """Node-local request-observatory snapshot: this raylet's
+        workers' serve trace rings (proxies and replicas are actors in
+        worker processes) — the per-node analog of the head's
+        /api/v0/serve_requests. Cross-process request joins need the
+        GCS merge; this surface is for poking one node."""
+        conn = await self._raylet()
+        return _json(await conn.request("reqtrace_node", {}, timeout=30))
+
     async def logs(self, request):
         log_dir = os.path.join(self.session_dir, "logs")
         out = []
@@ -257,6 +269,7 @@ async def amain(args) -> None:
     app.router.add_get("/api/v0/metrics", agent.metrics)
     app.router.add_get("/api/v0/steptrace", agent.steptrace)
     app.router.add_get("/api/v0/memview", agent.memview)
+    app.router.add_get("/api/v0/reqtrace", agent.reqtrace)
     app.router.add_get("/api/v0/logs", agent.logs)
     app.router.add_get("/api/v0/logs/tail", agent.tail)
     app.router.add_get("/api/v0/logs/range", agent.range)

@@ -185,6 +185,33 @@ def _build_app():
         )
         return _json_response(out)
 
+    @routes.get("/api/v0/serve_requests")
+    async def serve_requests(request):
+        """Request observatory for the Serve tab: per-request phase
+        rows joined by request id, per-deployment p50/p95/p99 + TTFT,
+        per-replica phase profiles, and slow-replica skew verdicts (one
+        reqtrace_cluster scrape — what `ray_tpu serve requests` prints).
+        A POLLING surface (5s SPA auto-refresh), so the merge is capped
+        to the newest records by default; ?limit=0 uncaps it."""
+        try:
+            limit = int(request.query.get("limit", "20000"))
+        except ValueError:
+            return _json_response({"error": "limit must be an integer"},
+                                  status=400)
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.serve_summary(limit=limit or None)
+        )
+        return _json_response(out)
+
+    @routes.get("/api/v0/serve_timeline")
+    async def serve_timeline(request):
+        """Merged per-request serve timeline as Chrome-trace JSON
+        (Perfetto-loadable; what `ray_tpu serve timeline` writes)."""
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.request_timeline(None)
+        )
+        return _json_response(out)
+
     @routes.get("/api/v0/metrics")
     async def metrics(request):
         from ray_tpu.util import metrics as m

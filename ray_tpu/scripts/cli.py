@@ -673,6 +673,106 @@ def cmd_serve_status(args):
         print(f"{app}: {info}")
 
 
+def _fmt_ms(v) -> str:
+    return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+
+def cmd_serve_requests(args):
+    """Request observatory: one cluster-wide serve trace scrape, merged
+    by request id — per-deployment p50/p95/p99 + TTFT, per-replica phase
+    profiles, slow-replica skew verdicts, and (with --slow) the slowest
+    individual requests with their full phase breakdown."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli",
+                 ignore_reinit_error=True)
+    try:
+        merged = state.serve_summary()
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(merged, f, indent=2, default=str)
+            print(f"request observatory dump -> {args.output}")
+        deps = merged.get("deployments") or []
+        reps = merged.get("replicas") or []
+        if args.deployment:
+            deps = [d for d in deps if d["deployment"] == args.deployment]
+            reps = [r for r in reps if r["deployment"] == args.deployment]
+        if not deps:
+            print("no serve requests traced (is a deployment receiving "
+                  "traffic, and is reqtrace_enabled on?)")
+        for d in deps:
+            ttft = f" ttft p50={_fmt_ms(d['ttft_p50'])} " \
+                   f"p99={_fmt_ms(d['ttft_p99'])}" \
+                if d.get("ttft_p50") is not None else ""
+            print(f"{d['app']}/{d['deployment']}: {d['count']} reqs  "
+                  f"p50={_fmt_ms(d['p50'])} p95={_fmt_ms(d['p95'])} "
+                  f"p99={_fmt_ms(d['p99'])}{ttft}")
+            phases = d.get("phase_mean") or {}
+            if phases:
+                print("    phase means: " + "  ".join(
+                    f"{ph}={_fmt_ms(v)}" for ph, v in phases.items()))
+            if d.get("missing_replica_side"):
+                print(f"    ! {d['missing_replica_side']} request(s) "
+                      f"missing their replica-side spans")
+        for r in reps[: args.top]:
+            phases = "  ".join(f"{ph}={_fmt_ms(v)}"
+                               for ph, v in (r.get("phase_mean") or {})
+                               .items())
+            print(f"  replica {r['replica']}: {r['count']} reqs  "
+                  f"mean={_fmt_ms(r['mean_total'])} "
+                  f"p95={_fmt_ms(r['p95'])}  {phases}")
+        for v in merged.get("verdicts") or ():
+            print(f"! {v['kind']} {v['app']}/{v['deployment']}: "
+                  f"{v['detail']}")
+        if args.slow:
+            rows = merged.get("requests") or []
+            if args.deployment:  # filter BEFORE the top-N slice
+                rows = [r for r in rows
+                        if r["deployment"] == args.deployment]
+            rows = sorted(rows, key=lambda r: -r["total"])[: args.slow]
+            print(f"slowest {len(rows)} requests:")
+            for row in rows:
+                phases = " ".join(
+                    f"{p['phase']}={_fmt_ms(p['dur'])}"
+                    for p in row["phases"])
+                ttft = f" ttft={_fmt_ms(row['ttft'])}" \
+                    if row.get("ttft") is not None else ""
+                miss = f" MISSING={row['missing']}" if row.get("missing") \
+                    else ""
+                print(f"  {row['rid']} {row['app']}/{row['deployment']} "
+                      f"replica={row['replica'] or '?'} "
+                      f"total={_fmt_ms(row['total'])}{ttft}  "
+                      f"{phases}{miss}")
+        if merged.get("dropped"):
+            print(f"({merged['dropped']} records dropped by full rings — "
+                  f"raise reqtrace_ring_size for longer windows)")
+        for err in merged.get("errors", ()):
+            print(f"! unreachable: {err}", file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_serve_timeline(args):
+    """Request observatory export: the merged per-request serve trace as
+    Chrome-trace / Perfetto JSON, one process row per replica (plus the
+    proxy side), each phase a slice stamped with its request id."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_resolve_address(args), namespace="_cli",
+                 ignore_reinit_error=True)
+    try:
+        path = args.output or \
+            f"ray-tpu-serve-timeline-{int(time.time())}.json"
+        trace = state.request_timeline(path)
+        merged_rows = sum(1 for ev in trace if ev.get("ph") == "X")
+        print(f"wrote {len(trace)} trace events to {path} "
+              f"({merged_rows} phase slices)")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
     from ray_tpu._private.perf import run_microbenchmarks
@@ -890,7 +990,9 @@ def main(argv=None):
     p.add_argument("--address")
     p.set_defaults(fn=cmd_microbenchmark)
 
-    p = sub.add_parser("serve", help="declarative Serve deploy/status")
+    p = sub.add_parser(
+        "serve",
+        help="declarative Serve deploy/status + request observatory")
     ssub = p.add_subparsers(dest="serve_command", required=True)
     sp = ssub.add_parser("deploy")
     sp.add_argument("config", help="JSON config file (ServeDeploySchema)")
@@ -899,6 +1001,31 @@ def main(argv=None):
     sp = ssub.add_parser("status")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve_status)
+    sp = ssub.add_parser(
+        "requests",
+        help="request observatory: per-deployment latency breakdown, "
+             "per-replica phase profiles, skew verdicts")
+    sp.add_argument("--deployment", help="only this deployment")
+    sp.add_argument("--slow", type=int, nargs="?", const=10, default=0,
+                    metavar="N",
+                    help="print the N slowest requests with full phase "
+                         "breakdown (default 10)")
+    sp.add_argument("--top", type=int, default=10,
+                    help="per-replica rows to print (default 10)")
+    sp.add_argument("-o", "--output",
+                    help="write the full merged JSON here (chaos triage "
+                         "dumps use this)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_requests)
+    sp = ssub.add_parser(
+        "timeline",
+        help="merged per-request serve timeline (Perfetto JSON), one "
+             "track per replica")
+    sp.add_argument("-o", "--output",
+                    help="output path (default ray-tpu-serve-timeline-"
+                         "<ts>.json)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_timeline)
 
     args = parser.parse_args(argv)
     if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":

@@ -14,6 +14,7 @@ import cloudpickle
 from ray_tpu.serve._common import (
     DEFAULT_APP_NAME,
     SERVE_CONTROLLER_NAME,
+    SERVE_NAMESPACE,
 )
 from ray_tpu.serve.deployment import Application, BoundDeployment
 from ray_tpu.serve.handle import DeploymentHandle
@@ -27,20 +28,22 @@ def _get_or_create_controller():
     import ray_tpu
 
     try:
-        return ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        return ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
     except Exception:
         pass
     from ray_tpu.serve.controller import ServeController
 
     ctrl_cls = ray_tpu.remote(
         num_cpus=0, name=SERVE_CONTROLLER_NAME, max_concurrency=100,
-        lifetime="detached",
+        lifetime="detached", namespace=SERVE_NAMESPACE,
     )(ServeController)
     try:
         return ctrl_cls.remote()
     except Exception:
         # lost the race: another driver created it
-        return ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        return ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
 
 
 def start(http_options: Optional[Dict[str, Any]] = None,
@@ -132,7 +135,8 @@ def http_port() -> Optional[int]:
 def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
     import ray_tpu
 
-    controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+    controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
     status = ray_tpu.get(controller.get_serve_status.remote(), timeout=30)
     if name not in status:
         raise ValueError(f"no serve app named {name!r}")
@@ -149,7 +153,8 @@ def status() -> Dict[str, Any]:
     import ray_tpu
 
     try:
-        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
     except Exception:
         return {}
     return ray_tpu.get(controller.get_serve_status.remote(), timeout=30)
@@ -158,7 +163,8 @@ def status() -> Dict[str, Any]:
 def delete(name: str, _blocking: bool = True):
     import ray_tpu
 
-    controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+    controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
     ray_tpu.get(controller.delete_app.remote(name), timeout=60)
 
 
@@ -167,7 +173,8 @@ def shutdown():
 
     global _http_port
     try:
-        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                             namespace=SERVE_NAMESPACE)
     except Exception:
         return
     try:
@@ -182,7 +189,8 @@ def shutdown():
         for n in ray_tpu.nodes():
             try:
                 ray_tpu.kill(
-                    ray_tpu.get_actor(f"SERVE_PROXY:{n['node_id'][:12]}")
+                    ray_tpu.get_actor(f"SERVE_PROXY:{n['node_id'][:12]}",
+                                      namespace=SERVE_NAMESPACE)
                 )
             except Exception:
                 pass

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.serve._common import (
     ROUTES_PUSH_CHANNEL,
     REPLICA_PUSH_CHANNEL,
+    SERVE_NAMESPACE,
     AutoscalingConfig,
     DeploymentConfig,
     ReplicaInfo,
@@ -43,6 +44,7 @@ class _DeploymentState:
         # handles read these for load-aware p2c routing (ray parity:
         # _private/router.py:262 replica queue-len probes)
         self.loads: Dict[str, float] = {}
+        self.loads_ts: Optional[float] = None  # when loads were collected
         self.target = config.num_replicas
         self.autoscaling = AutoscalingConfig.from_dict(
             config.autoscaling_config
@@ -117,6 +119,8 @@ class ServeController:
             }
         # graceful stops block up to graceful_shutdown_timeout_s per replica:
         # do them after releasing the lock so control RPCs stay responsive
+        if to_stop:
+            self._drain_reqtrace()
         for st in to_stop:
             self._stop_all(st)
         self._push_routes()
@@ -127,11 +131,29 @@ class ServeController:
             app = self._apps.pop(app_name, None)
             getattr(self, "_app_meta", {}).pop(app_name, None)
         if app:
+            self._drain_reqtrace()
             for st in app.values():
                 self._stop_all(st)
                 self._push_replicas(st)
         self._push_routes()
         return True
+
+    def _drain_reqtrace(self):
+        """Fold dying replicas' trace rings into the GCS aggregator's
+        accumulated log before killing them, so the deployment's request
+        history stays queryable after delete/shutdown (steptrace parity:
+        BackendExecutor fires one final scrape before the gang dies)."""
+        from ray_tpu._private import reqtrace
+
+        if not reqtrace.is_enabled():
+            return
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            cw = global_worker.core_worker
+            cw.io.run(cw.gcs.request("reqtrace_cluster", {"limit": 1}))
+        except Exception:  # best-effort: trace history is an observability nicety
+            logger.debug("final reqtrace drain failed", exc_info=True)
 
     def wait_for_ready(self, app_name: str, timeout_s: float = 60.0) -> bool:
         deadline = time.time() + timeout_s
@@ -160,14 +182,22 @@ class ServeController:
 
     def get_replica_state(self, app_name: str, deployment: str) -> dict:
         """Replica names + reported queue lengths in one round trip
-        (handles route with p2c over these loads)."""
+        (handles route with p2c over these loads). ``loads_age_s`` is how
+        old the load snapshot already is at reply time — handles age it
+        further and fall back to local inflight counts past the
+        staleness threshold (serve_replica_report_max_age_s)."""
         with self._lock:
             app = self._apps.get(app_name) or {}
             st = app.get(deployment)
             if st is None:
-                return {"names": [], "loads": {}}
+                return {"names": [], "loads": {}, "loads_age_s": None}
             names = list(st.replicas.keys()) or list(st.draining.keys())
-            return {"names": names, "loads": dict(st.loads)}
+            loads_ts = getattr(st, "loads_ts", None)
+            return {
+                "names": names, "loads": dict(st.loads),
+                "loads_age_s": (time.time() - loads_ts)
+                if loads_ts is not None else None,
+            }
 
     def get_routes(self) -> Dict[str, tuple]:
         """route_prefix -> (app_name, ingress deployment)."""
@@ -265,8 +295,14 @@ class ServeController:
             from ray_tpu.serve.replica import Replica
 
             opts = st.config.replica_actor_options()
+            # detached: replicas must survive the deploying driver's job
+            # teardown (the controller kills them explicitly on delete/
+            # scale-down/unhealthy) — a non-detached replica dies with
+            # the driver, bouncing the deployment and losing its traces
+            opts.setdefault("lifetime", "detached")
             actor_cls = ray_tpu.remote(
                 name=name,
+                namespace=SERVE_NAMESPACE,
                 max_concurrency=st.config.max_ongoing_requests,
                 **opts,
             )(Replica)
@@ -310,9 +346,12 @@ class ServeController:
         if st.draining and len(st.replicas) >= st.target:
             with self._lock:
                 drained, st.draining = dict(st.draining), {}
+            self._drain_reqtrace()
             for handle in drained.values():
                 self._graceful_stop(st, handle)
         # scale down
+        if len(st.replicas) > st.target:
+            self._drain_reqtrace()
         while len(st.replicas) > st.target:
             with self._lock:
                 name, handle = next(iter(st.replicas.items()))
@@ -330,6 +369,9 @@ class ServeController:
                     logger.warning("replica %s unhealthy; replacing", name)
                     with self._lock:
                         st.replicas.pop(name, None)
+                    # no drain here: the one ring worth saving belongs to
+                    # the wedged replica, which won't answer the scrape —
+                    # it would only stall the replace by the scrape timeout
                     try:
                         ray_tpu.kill(handle)
                     except Exception:
@@ -371,9 +413,11 @@ class ServeController:
                 )
             except Exception:
                 loads[name] = float("inf")
+        done_at = time.time()
         for st in states:
             if id(st) in new_loads:
                 st.loads = new_loads[id(st)]
+                st.loads_ts = done_at  # freshness stamp the handles age
 
     def _autoscale_once(self):
         with self._lock:
@@ -544,6 +588,8 @@ class ServeController:
                 try:
                     proxy_cls = ray_tpu.remote(
                         num_cpus=0, name=name, max_concurrency=1000,
+                        namespace=SERVE_NAMESPACE,
+                        lifetime="detached",  # survive driver-job teardown
                         scheduling_strategy=NodeAffinitySchedulingStrategy(
                             node_id=nid, soft=False
                         ),
@@ -557,7 +603,8 @@ class ServeController:
                 except ValueError:
                     # name taken: an earlier pass (or a controller
                     # restart) already created it — adopt it
-                    handle = ray_tpu.get_actor(name)
+                    handle = ray_tpu.get_actor(
+                        name, namespace=SERVE_NAMESPACE)
             except Exception:
                 logger.exception("failed to create serve proxy on node %s",
                                  nid[:12])

@@ -15,9 +15,14 @@ from __future__ import annotations
 
 import random
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.serve._common import REPLICA_PUSH_CHANNEL, SERVE_CONTROLLER_NAME
+from ray_tpu.serve._common import (
+    REPLICA_PUSH_CHANNEL,
+    SERVE_CONTROLLER_NAME,
+    SERVE_NAMESPACE,
+)
 
 _REFRESH_PERIOD_S = 1.0
 
@@ -118,7 +123,9 @@ class DeploymentResponse:
             # until the TTL reap
             info = out[STREAM_MARKER]
             try:
-                ray_tpu.get_actor(info["replica"]).cancel_stream.remote(
+                ray_tpu.get_actor(
+                    info["replica"], namespace=SERVE_NAMESPACE
+                ).cancel_stream.remote(
                     info["stream_id"]
                 )
             except Exception:
@@ -172,7 +179,8 @@ class DeploymentResponseGenerator:
             return
         info = first[STREAM_MARKER]
         self._stream_id = info["stream_id"]
-        self._actor = ray_tpu.get_actor(info["replica"])
+        self._actor = ray_tpu.get_actor(info["replica"],
+                                        namespace=SERVE_NAMESPACE)
 
     def __iter__(self):
         return self
@@ -255,6 +263,12 @@ class _PushRegistry:
 
 _push_registry = _PushRegistry()
 
+# live router states per (app, deployment): the serve_handle_inflight
+# gauge sums over ALL of a process's handles for the deployment (a
+# driver can hold several), and weakrefs let discarded handles drop out
+# instead of being pinned forever by the gauge closure
+_router_states: Dict[tuple, weakref.WeakSet] = {}
+
 
 class _RouterState:
     """Replica cache + load scores for one (app, deployment), shared by a
@@ -267,8 +281,39 @@ class _RouterState:
         self.replicas: List[Any] = []
         self.inflight: Dict[str, int] = {}
         self.reported: Dict[str, float] = {}
+        # staleness guard on the reported queue lengths: age the
+        # controller stamped at reply time + when WE received them — a
+        # snapshot older than serve_replica_report_max_age_s is ignored
+        # by score() (stale lengths steer routing silently otherwise)
+        self.reported_age0 = 0.0
+        self.reported_at: Optional[float] = None
+        self.report_max_age_s = 5.0
         self.last_refresh = 0.0
         self.push_subscribed = False
+        self._setup_metrics()
+
+    def _setup_metrics(self):
+        """Router-side inflight gauge (the instant local-view complement
+        of the replica-reported queue length): summed across every
+        process's router states by the cluster scrape. The set_fn closes
+        over a shared WeakSet of this (app, deployment)'s live states —
+        several handles sum instead of the last one winning, and a
+        discarded handle drops out rather than being pinned forever."""
+        try:
+            from ray_tpu._private import metrics_core as mc
+
+            states = _router_states.setdefault(
+                (self.app_name, self.deployment_name), weakref.WeakSet())
+            states.add(self)
+            mc.registry().gauge(
+                "serve_handle_inflight",
+                "requests this process's router has in flight, by "
+                "deployment",
+            ).labels(app=self.app_name, deployment=self.deployment_name
+                     ).set_fn(lambda: sum(
+                         sum(s.inflight.values()) for s in states))
+        except Exception:
+            pass
 
     def _subscribe_push(self):
         """Invalidate the replica cache the moment the controller pushes a
@@ -286,7 +331,8 @@ class _RouterState:
         import ray_tpu
 
         self._subscribe_push()
-        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME)
+        controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
         state = ray_tpu.get(
             controller.get_replica_state.remote(
                 self.app_name, self.deployment_name
@@ -297,18 +343,45 @@ class _RouterState:
         replicas = []
         for n in names:
             try:
-                replicas.append((n, ray_tpu.get_actor(n)))
+                replicas.append((n, ray_tpu.get_actor(
+                    n, namespace=SERVE_NAMESPACE)))
             except Exception:
                 pass
         self.replicas = replicas
         self.inflight = {n: self.inflight.get(n, 0) for n, _ in replicas}
         self.reported = {n: float(loads.get(n, 0.0)) for n, _ in replicas}
+        # the controller stamps how old its load snapshot already was at
+        # reply time; we add our own receive timestamp so score() can age
+        # it continuously
+        age0 = state.get("loads_age_s")
+        self.reported_age0 = float(age0) if age0 is not None else 0.0
+        self.reported_at = now if age0 is not None else None
+        try:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            self.report_max_age_s = float(
+                GLOBAL_CONFIG.serve_replica_report_max_age_s)
+        except Exception:
+            pass
         self.last_refresh = now
 
+    def reported_stale(self) -> bool:
+        """Are the replica-reported queue lengths too old to trust? A
+        controller that stopped collecting (wedged loop, partition)
+        keeps answering get_replica_state with its LAST snapshot — aging
+        it here is what stops stale lengths steering routing silently."""
+        if self.reported_at is None:
+            return True  # controller never reported an age: local only
+        age = self.reported_age0 + (time.monotonic() - self.reported_at)
+        return age > self.report_max_age_s
+
     def score(self, name: str) -> float:
-        # reported queue length (global view, ~1 control-loop period stale)
-        # + local in-flight (instant view of our own traffic)
-        return self.reported.get(name, 0.0) + self.inflight.get(name, 0)
+        # reported queue length (global view, ~1 control-loop period
+        # stale; DROPPED entirely beyond the staleness threshold) +
+        # local in-flight (instant view of our own traffic)
+        reported = 0.0 if self.reported_stale() \
+            else self.reported.get(name, 0.0)
+        return reported + self.inflight.get(name, 0)
 
     def pick(self):
         """Power-of-two-choices on reported + local load."""
@@ -325,11 +398,13 @@ class _RouterState:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: str = "__call__", stream: bool = False,
-                 _state: Optional[_RouterState] = None):
+                 _state: Optional[_RouterState] = None,
+                 _request_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._stream = stream
+        self._rid = _request_id
         self._state = _state or _RouterState(app_name, deployment_name)
 
     # handles are pickled into other replicas; drop live actor handles
@@ -344,12 +419,14 @@ class DeploymentHandle:
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
+                _request_id: Optional[str] = None,
                 **_ignored) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self._method,
             stream=self._stream if stream is None else stream,
             _state=self._state,
+            _request_id=_request_id or self._rid,
         )
 
     def __getattr__(self, name: str):
@@ -366,12 +443,20 @@ class DeploymentHandle:
 
     def _remote_attempt(self, args, kwargs, retries_left: int,
                         route_budget: Optional[float] = None):
+        from ray_tpu._private import reqtrace
+
         st = self._state
         deadline = time.monotonic() + (
             30.0 if route_budget is None else min(30.0, route_budget)
         )
+        # the proxy threads its minted id in via options(_request_id=);
+        # a handle called directly mints its own so replica-side spans
+        # still join into one request row
+        traced = reqtrace.is_enabled()
+        rid = (self._rid or reqtrace.new_request_id()) if traced else ""
         last_err = None
         while time.monotonic() < deadline:
+            t_route = time.time()
             try:
                 st.refresh()
                 name, actor = st.pick()
@@ -380,7 +465,28 @@ class DeploymentHandle:
                 time.sleep(0.1)
                 continue
             try:
-                ref = actor.handle_request.remote(self._method, args, kwargs)
+                meta = None
+                if traced:
+                    now = time.time()
+                    reqtrace.record_span(
+                        rid, "route", t_route, now,
+                        app=self.app_name, deployment=self.deployment_name,
+                        replica=name,
+                        detail={"replica": name,
+                                # chosen replica's count + total: O(1)
+                                # per record vs O(replicas) for the full
+                                # dict, which bloats every ring slot,
+                                # scrape, and dashboard poll at scale
+                                "inflight": st.inflight.get(name, 0),
+                                "inflight_total": sum(
+                                    st.inflight.values()),
+                                "reported_stale": st.reported_stale()})
+                    # the envelope's send timestamp is where the replica's
+                    # queue-wait span starts (caller clock, same epoch
+                    # tradeoff as steptrace)
+                    meta = {"rid": rid, "ts": now}
+                ref = actor.handle_request.remote(
+                    self._method, args, kwargs, meta)
                 st.inflight[name] = st.inflight.get(name, 0) + 1
 
                 def settle(n=name):

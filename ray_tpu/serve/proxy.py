@@ -293,7 +293,10 @@ class HTTPProxy:
         streaming: each yielded item is already a response proto)."""
         import ray_tpu
 
-        replica = ray_tpu.get_actor(info["replica"])
+        from ray_tpu.serve._common import SERVE_NAMESPACE
+
+        replica = ray_tpu.get_actor(info["replica"],
+                                    namespace=SERVE_NAMESPACE)
         sid = info["stream_id"]
         try:
             while True:
@@ -381,7 +384,10 @@ class HTTPProxy:
             _ROUTE_POLL_TTL_UNPUSHED_S
         if not force and time.monotonic() - self._routes_fetched_at < ttl:
             return
-        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        from ray_tpu.serve._common import SERVE_NAMESPACE
+
+        controller = ray_tpu.get_actor(
+            "SERVE_CONTROLLER", namespace=SERVE_NAMESPACE)
         self._routes = ray_tpu.get(controller.get_routes.remote(), timeout=10)
         self._routes_fetched_at = time.monotonic()
 
@@ -449,7 +455,10 @@ class HTTPProxy:
         loop = asyncio.get_running_loop()
 
         def fetch():
-            controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+            from ray_tpu.serve._common import SERVE_NAMESPACE
+
+            controller = ray_tpu.get_actor(
+                "SERVE_CONTROLLER", namespace=SERVE_NAMESPACE)
             return ray_tpu.get(controller.get_routes.remote(), timeout=10)
 
         self._routes = await loop.run_in_executor(self._pool, fetch)
@@ -481,8 +490,15 @@ class HTTPProxy:
     async def _handle_inner(self, request):
         from aiohttp import web
 
+        from ray_tpu._private import reqtrace
         from ray_tpu.serve.replica import STREAM_MARKER
 
+        # request observatory: mint the id every hop joins on; the
+        # ingress span covers route match + body read, and the id is
+        # echoed back as x-request-id so clients (and the load harness)
+        # can correlate a slow response with its merged trace row
+        t_recv = _time.time()
+        rid = reqtrace.new_request_id() if reqtrace.is_enabled() else ""
         await self._refresh_routes()
         m = self._match(request.path)
         if m is None:
@@ -504,6 +520,9 @@ class HTTPProxy:
             # must reach the mounted ASGI app intact
             raw_query_string=request.query_string,
         )
+        if rid:
+            reqtrace.record_span(rid, "ingress", t_recv, _time.time(),
+                                 app=app_name, deployment=ingress)
         key = (app_name, ingress)
         handle = self._handles.get(key)
         if handle is None:
@@ -511,6 +530,9 @@ class HTTPProxy:
 
             handle = DeploymentHandle(ingress, app_name)
             self._handles[key] = handle
+        # a cheap per-request derivative (shared router state) carrying
+        # the minted id into the handle→replica RPC envelope
+        h = handle.options(_request_id=rid) if rid else handle
         loop = asyncio.get_running_loop()
 
         def call():
@@ -523,7 +545,7 @@ class HTTPProxy:
             last = None
             for _attempt in range(3):
                 try:
-                    return ray_tpu.get(handle.remote(env).ref, timeout=60)
+                    return ray_tpu.get(h.remote(env).ref, timeout=60)
                 except Exception as e:  # noqa: BLE001
                     last = e
                     if "ActorDied" not in str(type(e).__name__) + str(e):
@@ -538,7 +560,21 @@ class HTTPProxy:
                                 text=f"{type(e).__name__}: {e}"), app_name
         if isinstance(result, dict) and STREAM_MARKER in result:
             return await self._stream_response(
-                request, result[STREAM_MARKER]), app_name
+                request, result[STREAM_MARKER], rid=rid, app=app_name,
+                deployment=ingress), app_name
+        t_ser = _time.time()
+        resp = self._render_response(result)
+        if rid:
+            resp.headers["x-request-id"] = rid
+            reqtrace.record_span(rid, "serialize", t_ser, _time.time(),
+                                 app=app_name, deployment=ingress)
+        return resp, app_name
+
+    @staticmethod
+    def _render_response(result):
+        """Handler result -> aiohttp response (the serialize phase)."""
+        from aiohttp import web
+
         from ray_tpu.serve._common import Response as ServeResponse
 
         if isinstance(result, ServeResponse):
@@ -552,28 +588,39 @@ class HTTPProxy:
                 if k.lower() not in ("content-length", "transfer-encoding")
             )
             return web.Response(status=result.status, headers=headers,
-                                body=result.body), app_name
+                                body=result.body)
         if isinstance(result, bytes):
-            return web.Response(body=result), app_name
+            return web.Response(body=result)
         if isinstance(result, str):
-            return web.Response(text=result), app_name
+            return web.Response(text=result)
         return web.json_response(
-            result, dumps=lambda o: json.dumps(o, default=str)), app_name
+            result, dumps=lambda o: json.dumps(o, default=str))
 
-    async def _stream_response(self, request, info):
+    async def _stream_response(self, request, info, rid: str = "",
+                               app: str = "", deployment: str = ""):
         """Chunked transfer of a generator deployment's output: each chunk
         flushes as the replica yields it, so clients read tokens while the
-        handler is still running (ray parity: http_proxy.py:395)."""
+        handler is still running (ray parity: http_proxy.py:395). The
+        request observatory marks the first and last byte flushed, making
+        streaming TTFT a first-class number."""
         import ray_tpu
         from aiohttp import web
 
+        from ray_tpu._private import reqtrace
+
         resp = web.StreamResponse()
         resp.headers["Content-Type"] = "text/plain; charset=utf-8"
+        if rid:
+            resp.headers["x-request-id"] = rid
         resp.enable_chunked_encoding()
         await resp.prepare(request)
-        replica = ray_tpu.get_actor(info["replica"])
+        from ray_tpu.serve._common import SERVE_NAMESPACE
+
+        replica = ray_tpu.get_actor(info["replica"],
+                                    namespace=SERVE_NAMESPACE)
         sid = info["stream_id"]
         loop = asyncio.get_running_loop()
+        first_byte_sent = False
         try:
             while True:
                 items, done = await loop.run_in_executor(
@@ -590,6 +637,12 @@ class HTTPProxy:
                     else:
                         chunk = (json.dumps(item, default=str) + "\n").encode()
                     await resp.write(chunk)
+                    if rid and not first_byte_sent:
+                        first_byte_sent = True
+                        reqtrace.record_mark(
+                            rid, "first_byte", _time.time(), app=app,
+                            deployment=deployment,
+                            replica=info.get("replica") or "")
                 if done:
                     break
         except Exception as e:  # noqa: BLE001 — mid-stream failure
@@ -603,4 +656,8 @@ class HTTPProxy:
             except Exception:
                 pass
         await resp.write_eof()
+        if rid:
+            reqtrace.record_mark(rid, "last_byte", _time.time(), app=app,
+                                 deployment=deployment,
+                                 replica=info.get("replica") or "")
         return resp

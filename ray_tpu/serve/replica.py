@@ -56,6 +56,10 @@ class Replica:
         self._name = replica_name
         self._ongoing = 0
         self._total = 0
+        # requests admitted (handle_request entered) but not yet in user
+        # code: the pool-queue/backlog depth the queue-depth gauge and
+        # the reqtrace "queue" span measure
+        self._queued = 0
         # sync user callables run here so concurrent requests don't
         # serialize on the actor's event loop
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -92,6 +96,11 @@ class Replica:
             reg.gauge("serve_replica_ongoing_requests",
                       "Requests in flight inside the replica"
                       ).labels(**tags).set_fn(lambda: self._ongoing)
+            reg.gauge("serve_replica_queue_depth",
+                      "Requests admitted to the replica but not yet in "
+                      "user code (pool backlog)"
+                      ).labels(replica=self._name or "?", **tags
+                               ).set_fn(lambda: self._queued)
             reg.gauge("serve_replica_total_requests",
                       "Requests handled by the replica (monotonic)"
                       ).labels(**tags).set_fn(lambda: self._total)
@@ -130,11 +139,56 @@ class Replica:
         return getattr(self._callable, method_name)
 
     async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict):
+                             kwargs: dict, meta: Optional[dict] = None):
+        from ray_tpu._private import reqtrace
+
         self._reap_stale_streams()
+        # request-observatory identity threaded through the RPC envelope
+        # by the handle: rid joins this hop's spans with the proxy's, ts
+        # is the caller-clock send time the queue-wait span starts at
+        rid = (meta or {}).get("rid") or ""
+        sent_ts = (meta or {}).get("ts")
         self._ongoing += 1
         self._total += 1
+        self._queued += 1
         t0 = time.perf_counter()
+        started = [False]
+        loop = asyncio.get_running_loop()
+
+        def _dec_queued():
+            self._queued -= 1
+
+        def _user_code_starts() -> float:
+            """Close the queue-wait interval (send → user code start);
+            runs on the loop for async targets, on the pool thread for
+            sync ones (ring appends are GIL-atomic; the _queued -= 1 is
+            NOT, so it marshals to the loop like _ongoing's stream
+            decrement — a pool-thread read-modify-write can lose a
+            concurrent admission's += otherwise)."""
+            if not started[0]:  # idempotent vs the finally's pairing
+                started[0] = True
+                loop.call_soon_threadsafe(_dec_queued)
+            now = time.time()
+            if rid:
+                reqtrace.record_span(
+                    rid, "queue",
+                    sent_ts if sent_ts is not None else now, now,
+                    app=self._app, deployment=self._deployment,
+                    replica=self._name or "")
+            return now
+
+        def _record_execute(t_exec: float):
+            if rid:
+                reqtrace.record_span(
+                    rid, "execute", t_exec, time.time(),
+                    app=self._app, deployment=self._deployment,
+                    replica=self._name or "")
+
+        # serve.batch flushes (and any nested helper) read the request
+        # identity from this contextvar — it propagates through awaits
+        ctx_token = reqtrace.CURRENT.set(
+            (rid, self._app, self._deployment, self._name or "")
+        ) if rid else None
         try:
             target = self._target(method_name)
             unbound = target if self._is_function or method_name not in (
@@ -142,7 +196,10 @@ class Replica:
             ) else getattr(self._callable, "__call__", target)
             if inspect.isasyncgenfunction(unbound) or \
                     inspect.isgeneratorfunction(unbound):
-                return self._start_stream(target, unbound, args, kwargs)
+                t_exec = _user_code_starts()
+                out = self._start_stream(target, unbound, args, kwargs)
+                _record_execute(t_exec)  # stream setup; bytes stream async
+                return out
             if inspect.iscoroutinefunction(target) or (
                 not self._is_function
                 and method_name in ("__call__", None)
@@ -150,15 +207,30 @@ class Replica:
                     getattr(self._callable, "__call__", None)
                 )
             ):
-                return await target(*args, **kwargs)
+                t_exec = _user_code_starts()
+                try:
+                    return await target(*args, **kwargs)
+                finally:
+                    _record_execute(t_exec)
             loop = asyncio.get_running_loop()
-            out = await loop.run_in_executor(
-                self._pool, lambda: target(*args, **kwargs)
-            )
+
+            def run():
+                t_exec = _user_code_starts()
+                try:
+                    return target(*args, **kwargs)
+                finally:
+                    _record_execute(t_exec)
+
+            out = await loop.run_in_executor(self._pool, run)
             if inspect.iscoroutine(out):
                 out = await out
             return out
         finally:
+            if not started[0]:  # failed before user code: pair the +=
+                started[0] = True
+                self._queued -= 1  # on the loop here: direct is safe
+            if ctx_token is not None:
+                reqtrace.CURRENT.reset(ctx_token)
             self._ongoing -= 1
             if self._m_latency is not None:
                 self._m_latency.record(time.perf_counter() - t0)

@@ -32,6 +32,8 @@ __all__ = [
     "timeline",
     "train_timeline",
     "steptrace_summary",
+    "serve_summary",
+    "request_timeline",
     "object_summary",
     "arena_summary",
     "profile_cpu",
@@ -393,6 +395,40 @@ def train_timeline(filename: Optional[str] = None) -> list:
 
     merged = steptrace_summary()
     trace = steptrace.chrome_trace(merged)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def serve_summary(limit: Optional[int] = None) -> dict:
+    """One cluster-wide request-observatory scrape, merged: per-request
+    rows joined by request id (every hop's phase spans — ingress, route
+    with the router's inflight snapshot, replica queue wait, batch
+    formation, execute, serialize — plus streaming first/last-byte
+    marks), per-deployment p50/p95/p99 + TTFT summaries, per-replica
+    phase profiles, and slow-replica skew verdicts ("replica r3 is slow,
+    and it's queue wait, not execute"). Triggers the GCS-side metrics
+    fold as a side effect, so ``serve_request_phase_seconds`` and
+    ``serve_request_ttft_seconds`` advance on the /metrics scrape.
+    ``limit`` caps the merge to the newest N accumulated records."""
+    return _gcs_request("reqtrace_cluster",
+                        {"limit": limit} if limit else {})
+
+
+def request_timeline(filename: Optional[str] = None) -> list:
+    """Merged serve request timeline as Chrome-trace JSON (Perfetto /
+    chrome://tracing loadable): one process row per replica (plus the
+    proxy side), phase slices per request, streaming first/last-byte
+    instants — the serve complement of ``train_timeline()``. Each slice
+    carries its request id, so one slow request reads end to end across
+    proxy and replica rows."""
+    import json
+
+    from ray_tpu._private import reqtrace
+
+    merged = serve_summary()
+    trace = reqtrace.chrome_trace(merged)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -84,6 +84,19 @@ PYEOF
     else
         echo "(no live cluster for a memory dump)" >&2
     fi
+    # Request-observatory triage: the merged per-request serve trace
+    # (per-deployment latency breakdown, per-replica phase profiles,
+    # slow-replica skew verdicts) from any reachable cluster — a chaos
+    # kill that wedged a replica shows up here as queue-wait attribution
+    # on the survivors, and missing-side rows name requests the dead
+    # replica took with it.
+    sv="${CHAOS_SERVE_REQUESTS_DUMP:-/tmp/chaos_serve_requests.json}"
+    if timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu serve requests -o "$sv" >&2 2>/dev/null; then
+        echo "serve request observatory dump -> $sv" >&2
+    else
+        echo "(no live cluster for a serve requests dump)" >&2
+    fi
     # Log-plane triage: the cluster log listing plus the last error lines
     # of the streamed worker logs — what a driver would have seen — so a
     # crashed task's final output lands next to the failing lane's report.

@@ -152,13 +152,15 @@ def test_dashboard_spa_and_full_api_surface(ray_start_regular):
         assert "ray_tpu" in body and 'id="nav"' in body
         for marker in ("overview", "timeline", "metrics", "filterBar",
                        "drawTimeline", "spark", "straggler", '"memory"',
-                       "fmtBytes"):
+                       "fmtBytes", '"serve"', "serve_requests",
+                       "dominant phase"):
             assert marker in body, f"SPA missing {marker}"
         # every endpoint the SPA's want-map fetches must answer
         for ep in ("nodes", "actors", "tasks?limit=1000", "objects?limit=500",
                    "memory", "placement_groups", "jobs", "events?limit=200",
                    "metrics", "metrics_history", "timeline", "train",
-                   "train_timeline", "tasks/summarize", "cluster_resources"):
+                   "train_timeline", "serve_requests", "serve_timeline",
+                   "tasks/summarize", "cluster_resources"):
             out = _get(port, f"/api/v0/{ep}")
             assert out is not None, ep
         nodes = _get(port, "/api/v0/nodes")

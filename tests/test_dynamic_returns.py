@@ -3,6 +3,7 @@ objects; the visible ref resolves to the per-item ObjectRefs
 (ray parity: task_manager.h:96 ObjectRefStream / dynamic generators)."""
 
 import numpy as np
+import pytest
 
 import ray_tpu
 
@@ -34,6 +35,7 @@ def test_dynamic_returns_empty_and_list(ray_start_regular):
     assert [ray_tpu.get(r, timeout=60)[:1] for r in refs] == [b"a", b"b"]
 
 
+@pytest.mark.slow  # ~60s of reconstruction timeouts: slow lane (tier-1 budget)
 def test_dynamic_item_lineage_reconstruction(ray_start_regular):
     """Deleting a dynamic item's plasma file behind the runtime triggers
     re-execution of the producing task (lineage adopted by the caller)."""

@@ -68,6 +68,7 @@ def test_borrower_keeps_object_alive_then_release_frees(ray_start_regular_fn):
               msg="object freed after borrower release")
 
 
+@pytest.mark.slow  # ~63s of reconstruction timeouts: slow lane (tier-1 budget)
 def test_lineage_reconstruction_on_lost_object(ray_start_regular_fn, tmp_path):
     marker = str(tmp_path / "runs")
 
@@ -91,6 +92,7 @@ def test_lineage_reconstruction_on_lost_object(ray_start_regular_fn, tmp_path):
     assert open(marker).read() == "xx", "producing task was not re-executed"
 
 
+@pytest.mark.slow  # ~62s of reconstruction timeouts: slow lane (tier-1 budget)
 def test_put_objects_are_not_reconstructable(ray_start_regular_fn):
     ref = ray_tpu.put(np.zeros(1 << 19, dtype=np.float64))
     v = ray_tpu.get(ref, timeout=60)

@@ -225,6 +225,7 @@ def test_sac_improves(ray_start_regular):
     assert best > max(first, 25.0), (first, best)
 
 
+@pytest.mark.slow  # 23s learning-threshold test: slow lane (tier-1 budget)
 def test_multi_agent_ppo_two_policies(ray_start_regular):
     """Two policies over four agents: both improve on multi-agent
     CartPole; per-policy batches stay separate."""

@@ -32,6 +32,7 @@ def test_module_shapes_and_latent_sampling():
     assert lg.shape == (3, cfg.latent_cats, cfg.latent_classes)
 
 
+@pytest.mark.slow  # 69s learning-threshold test: slow lane (tier-1 budget)
 def test_dreamerv3_learns_cartpole():
     """The world model + imagination-trained actor must clearly beat a
     random policy (~20 return) within ~7k env steps — the

@@ -20,7 +20,7 @@ def typed_serve(ray_start_regular):
         ],
     })
     serve.run(text_app, name="textapp", route_prefix="/")
-    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
     proxies = ray_tpu.get(controller.get_proxies.remote(), timeout=30)
     port = next(iter(proxies.values()))["grpc_port"]
     assert port, "gRPC proxy did not start"

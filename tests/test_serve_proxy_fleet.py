@@ -11,7 +11,7 @@ from ray_tpu import serve
 
 
 def _controller():
-    return ray_tpu.get_actor("SERVE_CONTROLLER")
+    return ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
 
 
 def test_proxy_fleet_multi_node_and_grpc(ray_start_cluster):
@@ -69,7 +69,7 @@ def test_proxy_fleet_multi_node_and_grpc(ray_start_cluster):
         # proxy, and the controller restarts the dead one.
         victim_nid, victim = next(iter(proxies.items()))
         other = [v for k, v in proxies.items() if k != victim_nid][0]
-        ray_tpu.kill(ray_tpu.get_actor(victim["name"]))
+        ray_tpu.kill(ray_tpu.get_actor(victim["name"], namespace="serve"))
         r = requests.get(f"http://127.0.0.1:{other['port']}/alive",
                          timeout=30)
         assert r.status_code == 200

@@ -144,12 +144,12 @@ def test_p2c_uses_reported_queue_lens(serve_cluster):
     # handle would p2c-balance them — the point is to create the skew an
     # independent caller produces, which fresh handles can only see via
     # controller-reported loads)
-    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
     names = ray_tpu.get(
         controller.get_replica_names.remote("p2c", "Who"), timeout=30
     )
     assert len(names) == 2
-    busy_actor = ray_tpu.get_actor(names[0])
+    busy_actor = ray_tpu.get_actor(names[0], namespace="serve")
     busy = [
         busy_actor.handle_request.remote("__call__", (8.0,), {})
         for _ in range(4)

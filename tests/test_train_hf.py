@@ -2,8 +2,10 @@
 report/checkpoint bridging (ray parity: train/huggingface/transformers)."""
 
 import numpy as np
+import pytest
 
 
+@pytest.mark.slow  # 27s HF-integration test: slow lane (tier-1 budget)
 def test_transformers_trainer_two_workers(ray_start_regular, tmp_path):
     import ray_tpu.train as train
     from ray_tpu.air.config import RunConfig, ScalingConfig
