@@ -744,6 +744,59 @@ def _object_plane_main():
     os._exit(0)
 
 
+def _schedsim_main():
+    """BENCH_SCHEDSIM=1: the gang-scheduler acceptance lane — schedsim
+    (deterministic discrete-event simulator over the REAL placement-
+    scoring code paths) at 10k simulated nodes, A/B-ing the contention-
+    aware policy against resource-fit-only placement. Gated on (a)
+    determinism: same seed -> byte-identical event trace; (b) the
+    contention policy's aggregate ring-overlap <= baseline's; (c) the
+    10k-node run finishing single-process in <60s. Reported value is the
+    contention/baseline overlap ratio (0.0 = the new policy eliminated
+    ring sharing entirely). BENCH_SMALL shrinks to 1k nodes. Emits ONE
+    JSON line, same contract as the default bench path."""
+    from ray_tpu._private import schedsim
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    nodes = int(os.environ.get("BENCH_SCHEDSIM_NODES",
+                               "1000" if small else "10000"))
+    seed = int(os.environ.get("BENCH_SCHEDSIM_SEED", "1"))
+    chaos = os.environ.get("BENCH_SCHEDSIM_CHAOS", "")
+
+    def one(policy):
+        spec = schedsim.SimSpec(nodes=nodes, policy=policy, seed=seed,
+                                chaos=chaos)
+        t0 = time.perf_counter()
+        report = schedsim.run(spec)
+        report["wall_s"] = round(time.perf_counter() - t0, 2)
+        return report
+
+    cont = one("contention")
+    base = one("baseline")
+    replay = one("contention")  # determinism gate: byte-identical trace
+    deterministic = replay["trace_sha256"] == cont["trace_sha256"]
+    denom = base["total_contention"]
+    ratio = cont["total_contention"] / denom if denom else 0.0
+    ok = (deterministic
+          and cont["total_contention"] <= base["total_contention"]
+          and cont["wall_s"] < 60.0
+          and cont["placed"] > 0)
+    print(json.dumps({
+        "metric": "schedsim_contention_vs_baseline_overlap",
+        "value": round(ratio, 4),
+        "unit": "ratio (lower is better; 0 = no shared ring links)",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "nodes": nodes,
+            "seed": seed,
+            "deterministic": deterministic,
+            "contention": cont,
+            "baseline": base,
+        },
+    }), flush=True)
+    os._exit(0)
+
+
 def main():
     signal.signal(signal.SIGTERM, _emit_and_exit)
     threading.Thread(target=_watchdog_thread, daemon=True).start()
@@ -764,6 +817,8 @@ def main():
         _serve_load_main()
     if os.environ.get("BENCH_OBJECT_PLANE"):
         _object_plane_main()
+    if os.environ.get("BENCH_SCHEDSIM"):
+        _schedsim_main()
 
     on_tpu = _tpu_reachable()
 

@@ -322,9 +322,33 @@ def pick_node(
 
 
 def place_bundles(
-    nodes: List[NodeInfo], bundles: List[Dict[str, float]], strategy: str
+    nodes: List[NodeInfo], bundles: List[Dict[str, float]], strategy: str,
+    topology=None, committed_rings=None, max_candidates=None,
 ) -> Optional[List[str]]:
-    """Return node_id per bundle, or None if infeasible."""
+    """Return node_id per bundle, or None if infeasible.
+
+    ``topology``/``committed_rings`` (topology.py) thread the contention
+    scorer through this wrapper: when the cluster advertises torus
+    coordinates, candidates are torus-aligned contiguous slices scored
+    by ring overlap against already-committed gangs. Topology-less
+    clusters (the default: topology=None, or no coords advertised) take
+    the resource-fit path below — native engine or Python oracle —
+    byte-identical to before the scorer existed."""
+    if topology is not None:
+        from ray_tpu._private import topology as topo_mod
+
+        if max_candidates is None:
+            # live clusters take the config knob; schedsim passes its
+            # SimSpec value explicitly so a trace's byte-identity never
+            # depends on ambient process config
+            from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+            max_candidates = cfg.sched_max_candidates
+        scored = topo_mod.place_bundles_topo(
+            nodes, bundles, strategy, topology, committed_rings or {},
+            max_candidates=max_candidates,
+        )
+        return None if scored is None else scored[0]
     from ray_tpu._private import native_sched
 
     if native_sched.available() and native_sched.encodable(

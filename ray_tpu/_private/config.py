@@ -70,6 +70,20 @@ _flag("scheduler_spread_threshold", float, 0.5)
 _flag("scheduler_top_k_fraction", float, 0.2)
 _flag("max_spillback_depth", int, 10)
 _flag("worker_lease_timeout_ms", int, 30_000)
+# Topology-aware gang scheduling (topology.py): nodes advertise torus
+# coordinates via labels (torus-coord="0x1[x2]", torus-dims="4x4[x8]" —
+# TPU-style "x" separators keep the labels wire-safe for the native
+# scheduler), synthesized here from per-node env/config the way the
+# reference synthesizes TPU slice topology. Placement-group scheduling
+# then scores candidate placements by ring-allreduce link overlap
+# against committed gangs and prefers torus-aligned contiguous slices;
+# clusters with no coords advertised take the resource-fit path
+# untouched.
+_flag("sched_topology_enabled", bool, True)
+_flag("torus_coord", str, "")  # this node's "0x1[x2]" (per-node env)
+_flag("torus_dims", str, "")  # the torus extent "4x4[x8]"
+_flag("sched_max_candidates", int, 32)  # slice windows scored per gang
+_flag("sched_repack_max_moves", int, 8)  # bundle migrations per repack
 # Workers
 _flag("num_workers_soft_limit", int, 16)
 _flag("worker_register_timeout_s", float, 60.0)

@@ -106,6 +106,15 @@ class PlacementGroupRecord:
         self.lifetime = lifetime
         self.state = "PENDING"
         self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+        # topology-aware scheduling provenance (topology.py): the torus
+        # coord per bundle host, the ring-overlap contention score of the
+        # chosen placement, which scoring path chose it
+        # ("topology-contention" | "resource-fit"), and how many pending
+        # bundles the fragmentation repack pass migrated to place it
+        self.node_coords: List[Optional[str]] = [None] * len(bundles)
+        self.contention_score: Optional[float] = None
+        self.sched_strategy: str = "resource-fit"
+        self.repack_moves: int = 0
 
     def dump(self) -> dict:
         return {
@@ -113,6 +122,10 @@ class PlacementGroupRecord:
             "strategy": self.strategy, "name": self.name,
             "job_id": self.job_id, "lifetime": self.lifetime,
             "state": self.state, "bundle_nodes": self.bundle_nodes,
+            "node_coords": self.node_coords,
+            "contention_score": self.contention_score,
+            "sched_strategy": self.sched_strategy,
+            "repack_moves": self.repack_moves,
         }
 
     @classmethod
@@ -121,6 +134,11 @@ class PlacementGroupRecord:
                  d["job_id"], d["lifetime"])
         pg.state = d["state"]
         pg.bundle_nodes = list(d["bundle_nodes"])
+        pg.node_coords = list(d.get("node_coords")
+                              or [None] * len(pg.bundles))
+        pg.contention_score = d.get("contention_score")
+        pg.sched_strategy = d.get("sched_strategy", "resource-fit")
+        pg.repack_moves = d.get("repack_moves", 0)
         return pg
 
     def to_table(self):
@@ -131,6 +149,10 @@ class PlacementGroupRecord:
             "strategy": self.strategy,
             "state": self.state,
             "bundle_nodes": self.bundle_nodes,
+            "node_coords": self.node_coords,
+            "contention_score": self.contention_score,
+            "sched_strategy": self.sched_strategy,
+            "repack_moves": self.repack_moves,
         }
 
 
@@ -155,6 +177,11 @@ class GcsServer:
         self._pub_buf: Dict[Connection, list] = {}  # batched pubsub outbox
         self._pub_flush: Optional[asyncio.Task] = None
         self._pg_lock = asyncio.Lock()
+        # committed gang rings (topology.py): pg_id -> frozenset of torus
+        # links its induced allreduce ring occupies; feeds the contention
+        # score of every later placement + sched_ring_overlap_ratio
+        self._pg_rings: Dict[str, frozenset] = {}
+        self._sched_repacks = 0  # bundles migrated by the repack pass
         self._next_job = 1
         self._started = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
@@ -222,6 +249,23 @@ class GcsServer:
         reg.gauge("gcs_subscriber_conns", "Pubsub subscriber connections"
                   ).set_fn(lambda: sum(len(s)
                                        for s in self.subscribers.values()))
+        # gang-scheduler health: aggregate ring overlap across committed
+        # gangs (0 = every gang owns its torus links) + repack activity
+        reg.gauge(
+            "sched_ring_overlap_ratio",
+            "Pairwise shared torus links / total ring links across "
+            "committed placement-group gangs",
+        ).set_fn(self._ring_overlap_ratio)
+        reg.counter(
+            "sched_repack_total",
+            "Pending placement-group bundles migrated by the "
+            "fragmentation repack pass",
+        ).set_fn(lambda: self._sched_repacks)
+
+    def _ring_overlap_ratio(self) -> float:
+        from ray_tpu._private import topology
+
+        return topology.overlap_ratio(self._pg_rings)
 
     async def start(self):
         port = await self.server.start()
@@ -256,6 +300,8 @@ class GcsServer:
             ):
                 pg.state = "PENDING"
                 pg.bundle_nodes = [None] * len(pg.bundles)
+                self._reset_pg_provenance(pg)
+                self._pg_rings.pop(pg.pg_id, None)
                 self._persist_pg(pg)
                 spawn(self._schedule_pg(pg))
         # Jobs whose driver never reconnected: treat the driver as dead (its
@@ -931,63 +977,276 @@ class GcsServer:
             self._persist_pg(pg)
             await self._publish("pg", pg.to_table())
 
+    def _committed_rings(self, but: Optional[str] = None,
+                         topo=None) -> dict:
+        """Rings of committed gangs, excluding ``but`` (a re-placed PG
+        must not contend against its own stale ring). Rings missing from
+        the registry (a restarted GCS replays pg tables but not rings)
+        are rebuilt from the replayed bundle_nodes when a topology is at
+        hand."""
+        if topo is not None:
+            for pg in self.pgs.values():
+                if (pg.state == "CREATED" and pg.pg_id != but
+                        and pg.pg_id not in self._pg_rings):
+                    self._pg_rings[pg.pg_id] = topo.ring_links(
+                        [n for n in pg.bundle_nodes if n])
+        return {
+            pg_id: ring for pg_id, ring in self._pg_rings.items()
+            if pg_id != but
+            and (p := self.pgs.get(pg_id)) is not None
+            and p.state == "CREATED"
+        }
+
+    def _idle_bundles(self, but: str) -> list:
+        """Committed bundles with zero consumption — PENDING in the sense
+        that nothing runs against their reserved resources yet, so they
+        are safe to migrate. The GCS already sees this through the
+        heartbeat view: a bundle's pg-formatted resources sit at full
+        availability on its host iff no task/actor has claimed any of
+        them. Rows: (pg_id, bundle_index, node_id, original_resources)."""
+        from ray_tpu._private.common import (RESOURCE_QUANT,
+                                             rewrite_resources_for_pg)
+
+        rows = []
+        for pg in self.pgs.values():
+            if pg.pg_id == but or pg.state != "CREATED":
+                continue
+            for idx, node_id in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(node_id) if node_id else None
+                if node is None or not node.alive:
+                    continue
+                named = rewrite_resources_for_pg(
+                    pg.bundles[idx], pg.pg_id, idx)
+                if all(abs(node.resources_available.get(k, 0.0) - v)
+                       < RESOURCE_QUANT / 2 for k, v in named.items()):
+                    rows.append((pg.pg_id, idx, node_id,
+                                 dict(pg.bundles[idx])))
+        return rows
+
+    async def _prepare_and_commit(self, pg_id: str, placements: list,
+                                  bundles: list) -> bool:
+        """2-phase reserve: prepare every (idx, node) row, cancel all on
+        any failure, else commit all. ``placements`` is [(idx, node_id)]."""
+        prepared = []
+        ok = True
+        for idx, node_id in placements:
+            nconn = self.node_conns.get(node_id)
+            if nconn is None:
+                ok = False
+                break
+            try:
+                # no rpc idem token: prepare/cancel cycles across
+                # placement attempts would replay stale results.
+                # Dedup is app-level — rpc_pg_prepare acks a bundle
+                # it already holds without double-reserving.
+                r = await nconn.request(
+                    "pg_prepare",
+                    {"pg_id": pg_id, "bundle_index": idx,
+                     "resources": bundles[idx]},
+                    timeout=cfg.gcs_rpc_timeout_s,
+                )
+            except Exception:
+                ok = False
+                break
+            if not r.get("ok"):
+                ok = False
+                break
+            prepared.append((idx, node_id))
+        if not ok:
+            for idx, node_id in prepared:
+                nconn = self.node_conns.get(node_id)
+                if nconn:
+                    try:
+                        await nconn.notify(
+                            "pg_cancel",
+                            {"pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+            return False
+        for idx, node_id in prepared:
+            nconn = self.node_conns.get(node_id)
+            try:
+                if nconn is None:  # raylet died between prepare and commit
+                    raise ConnectionError(f"raylet {node_id[:12]} gone")
+                await nconn.request(
+                    "pg_commit", {"pg_id": pg_id, "bundle_index": idx},
+                    timeout=cfg.gcs_rpc_timeout_s,
+                )
+            except Exception:
+                # roll every reservation back (committed or not —
+                # pg_cancel pops the bundle either way) instead of
+                # crashing the scheduling task and stranding the PG
+                for i2, n2 in prepared:
+                    c2 = self.node_conns.get(n2)
+                    if c2:
+                        try:
+                            await c2.notify(
+                                "pg_cancel",
+                                {"pg_id": pg_id, "bundle_index": i2})
+                        except Exception:
+                            pass
+                return False
+        return True
+
+    def _reset_pg_provenance(self, pg: PlacementGroupRecord):
+        pg.node_coords = [None] * len(pg.bundles)
+        pg.contention_score = None
+        pg.sched_strategy = "resource-fit"
+        pg.repack_moves = 0
+
+    async def _requeue_pg(self, pg: PlacementGroupRecord):
+        """A repack failure left this PG's reservations in doubt: return
+        every bundle (best effort, idempotent raylet-side), reset the
+        record to PENDING, and reschedule from scratch — a CREATED row
+        pointing at a reservation no raylet holds would strand every
+        actor targeting it as infeasible forever."""
+        for idx, node_id in enumerate(pg.bundle_nodes):
+            nconn = self.node_conns.get(node_id) if node_id else None
+            if nconn:
+                try:
+                    await nconn.notify(
+                        "pg_return",
+                        {"pg_id": pg.pg_id, "bundle_index": idx})
+                except Exception:
+                    pass
+        pg.state = "PENDING"
+        pg.bundle_nodes = [None] * len(pg.bundles)
+        self._reset_pg_provenance(pg)
+        self._pg_rings.pop(pg.pg_id, None)
+        self._persist_pg(pg)
+        await self._publish("pg", pg.to_table())
+        spawn(self._schedule_pg(pg))
+
+    async def _execute_repack(self, moves: list, topo) -> bool:
+        """Apply a repack plan (topology.plan_repack): migrate each idle
+        bundle return->prepare->commit, updating its PG's table row and
+        ring. A failed target prepare re-prepares on the origin (best
+        effort); if even that fails — or the conditional release's fate
+        is unknown (rpc error) — the victim PG is requeued for a fresh
+        placement rather than left CREATED with a phantom reservation."""
+        for mv in moves:
+            src = self.node_conns.get(mv.from_node)
+            dst = self.node_conns.get(mv.to_node)
+            victim = self.pgs.get(mv.pg_id)
+            if dst is None or src is None:
+                return False
+            try:
+                # conditional release: the raylet is the authority on
+                # whether the bundle is still idle — our heartbeat view
+                # can be a beat stale, and a bundle a fresh actor just
+                # claimed must not be migrated out from under it
+                r = await src.request(
+                    "pg_return_if_idle",
+                    {"pg_id": mv.pg_id, "bundle_index": mv.bundle_index},
+                    timeout=cfg.gcs_rpc_timeout_s)
+            except Exception:
+                # ambiguous: the raylet may have released before the rpc
+                # failed — reconcile by re-placing the victim entirely
+                if victim is not None:
+                    await self._requeue_pg(victim)
+                return False
+            if not r.get("ok"):
+                return False
+            ok = await self._prepare_and_commit(
+                mv.pg_id, [(mv.bundle_index, mv.to_node)],
+                {mv.bundle_index: mv.resources})
+            if not ok:
+                restored = await self._prepare_and_commit(
+                    mv.pg_id, [(mv.bundle_index, mv.from_node)],
+                    {mv.bundle_index: mv.resources})
+                if not restored and victim is not None:
+                    await self._requeue_pg(victim)
+                return False
+            moved_pg = self.pgs.get(mv.pg_id)
+            if moved_pg is not None:
+                moved_pg.bundle_nodes[mv.bundle_index] = mv.to_node
+                moved_pg.repack_moves += 1
+                if topo is not None:
+                    from ray_tpu._private import topology as topo_mod
+
+                    coord = topo.coords.get(mv.to_node)
+                    moved_pg.node_coords[mv.bundle_index] = (
+                        topo_mod.format_coord(coord)
+                        if coord is not None else None)
+                    self._pg_rings[mv.pg_id] = topo.ring_links(
+                        [n for n in moved_pg.bundle_nodes if n])
+                self._persist_pg(moved_pg)
+                await self._publish("pg", moved_pg.to_table())
+            self._sched_repacks += 1
+            self._record_event(
+                "INFO", "gcs", "PG_REPACK",
+                f"migrated bundle {mv.bundle_index} of pg "
+                f"{mv.pg_id[:12]} {mv.from_node[:12]} -> "
+                f"{mv.to_node[:12]} (defragmentation)",
+                {"pg_id": mv.pg_id, "bundle_index": mv.bundle_index,
+                 "from_node": mv.from_node, "to_node": mv.to_node},
+            )
+        return True
+
     async def _try_place_pg(self, pg: PlacementGroupRecord) -> bool:
+        from ray_tpu._private import topology as topo_mod
+
         # The lock covers one atomic place+prepare+commit attempt so two PGs
         # don't interleave reservations; waiting happens outside it.
         async with self._pg_lock:
-                nodes = [n for n in self.nodes.values() if n.alive]
-                placement = place_bundles(nodes, pg.bundles, pg.strategy)
-                if placement is None:
-                    return False
-                # Phase 1: prepare (reserve) on each node.
-                prepared = []
-                ok = True
-                for idx, node_id in enumerate(placement):
-                    nconn = self.node_conns.get(node_id)
-                    if nconn is None:
-                        ok = False
-                        break
-                    try:
-                        # no rpc idem token: prepare/cancel cycles across
-                        # placement attempts would replay stale results.
-                        # Dedup is app-level — rpc_pg_prepare acks a bundle
-                        # it already holds without double-reserving.
-                        r = await nconn.request(
-                            "pg_prepare",
-                            {"pg_id": pg.pg_id, "bundle_index": idx,
-                             "resources": pg.bundles[idx]},
-                            timeout=cfg.gcs_rpc_timeout_s,
-                        )
-                    except Exception:
-                        ok = False
-                        break
-                    if not r.get("ok"):
-                        ok = False
-                        break
-                    prepared.append((idx, node_id))
-                if not ok:
-                    for idx, node_id in prepared:
-                        nconn = self.node_conns.get(node_id)
-                        if nconn:
-                            try:
-                                await nconn.notify(
-                                    "pg_cancel", {"pg_id": pg.pg_id, "bundle_index": idx}
-                                )
-                            except Exception:
-                                pass
-                    return False
-                # Phase 2: commit.
-                for idx, node_id in prepared:
-                    nconn = self.node_conns.get(node_id)
-                    await nconn.request(
-                        "pg_commit", {"pg_id": pg.pg_id, "bundle_index": idx},
-                        timeout=cfg.gcs_rpc_timeout_s,
-                    )
-                pg.bundle_nodes = list(placement)
-                pg.state = "CREATED"
-                self._persist_pg(pg)
-                await self._publish("pg", pg.to_table())
-                return True
+            nodes = [n for n in self.nodes.values() if n.alive]
+            topo = (topo_mod.Topology.from_nodes(nodes)
+                    if cfg.sched_topology_enabled else None)
+            committed = self._committed_rings(but=pg.pg_id, topo=topo)
+            # one dispatch point for both worlds: the wrapper takes the
+            # contention path when a topology is passed and the untouched
+            # native/py resource-fit path otherwise
+            placement = place_bundles(nodes, pg.bundles, pg.strategy,
+                                      topology=topo,
+                                      committed_rings=committed)
+            moves: list = []
+            if placement is None and topo is not None \
+                    and pg.strategy == "STRICT_SPREAD":
+                # fragmentation repack: migrate committed-but-unused
+                # bundles of other gangs to open enough distinct nodes.
+                # Topology-gated on purpose — the degrade contract says a
+                # coord-less cluster behaves byte-identically to the old
+                # resource-fit path, which never migrated anything.
+                plan = topo_mod.plan_repack(
+                    nodes, pg.bundles, pg.strategy,
+                    self._idle_bundles(but=pg.pg_id),
+                    max_moves=cfg.sched_repack_max_moves)
+                if plan is not None:
+                    placement, moves = plan
+            if placement is None:
+                return False
+            if moves and not await self._execute_repack(moves, topo):
+                return False
+            if not await self._prepare_and_commit(
+                    pg.pg_id, list(enumerate(placement)), pg.bundles):
+                return False
+            pg.bundle_nodes = list(placement)
+            pg.state = "CREATED"
+            pg.repack_moves = len(moves)
+            if topo is not None:
+                pg.node_coords = [
+                    topo_mod.format_coord(topo.coords[nid])
+                    if nid in topo.coords else None
+                    for nid in placement
+                ]
+                self._pg_rings[pg.pg_id] = topo.ring_links(placement)
+                if moves:
+                    # the repack rewrote other gangs' rings: score against
+                    # the CURRENT registry, not the pre-repack snapshot,
+                    # and label the provenance honestly (plan_repack
+                    # places by resource fit, not contention)
+                    committed = self._committed_rings(but=pg.pg_id)
+                score = topo.score(placement, committed)
+                pg.contention_score = float(score.contention)
+                pg.sched_strategy = ("topology-repack" if moves
+                                     else "topology-contention")
+            else:
+                pg.node_coords = [None] * len(placement)
+                pg.contention_score = None
+                pg.sched_strategy = "resource-fit"
+            self._persist_pg(pg)
+            await self._publish("pg", pg.to_table())
+            return True
 
     async def rpc_wait_placement_group(self, conn: Connection, p):
         deadline = time.monotonic() + p.get("timeout", cfg.gcs_rpc_timeout_s)
@@ -1022,6 +1281,7 @@ class GcsServer:
                 except Exception:
                     pass
         pg.state = "REMOVED"
+        self._pg_rings.pop(pg_id, None)
         self._persist_pg(pg)
         await self._publish("pg", pg.to_table())
 

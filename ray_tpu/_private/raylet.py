@@ -392,6 +392,14 @@ class Raylet:
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.labels = labels or {}
+        # Advertise this node's torus coordinate to the gang scheduler
+        # (topology.py reads these labels off the GCS node table). Config-
+        # synthesized for now, like the reference's TPU slice env vars;
+        # explicit labels win over the config flags.
+        if cfg.torus_coord:
+            self.labels.setdefault("torus-coord", cfg.torus_coord)
+        if cfg.torus_dims:
+            self.labels.setdefault("torus-dims", cfg.torus_dims)
         self.gcs: Optional[Connection] = None
         self.cluster_view: Dict[str, NodeInfo] = {}
         self.peers: Dict[str, Connection] = {}
@@ -576,10 +584,16 @@ class Raylet:
         reg.counter("raylet_log_tail_cpu_seconds_total",
                     "CPU seconds spent tailing+attributing worker logs"
                     ).labels(**ltags).set_fn(lambda: self._log_tail_cpu_s)
+        # path="raylet": ready-queue entry -> worker dispatch on this
+        # node. The driver-side direct-lease pump records the same family
+        # with path="direct" (enqueue -> push to a leased worker), so the
+        # live histogram schedsim calibrates against covers BOTH dispatch
+        # paths (plain driver tasks bypass the raylet ready queue).
         self._placement_lat = reg.histogram(
             "raylet_task_placement_latency_seconds",
-            "Ready-queue entry to worker dispatch", scale=mc.LATENCY,
-        ).labels(**tags)
+            "Task ready to dispatched-to-worker, by dispatch path",
+            scale=mc.LATENCY,
+        ).labels(**dict(tags, path="raylet"))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -3232,6 +3246,32 @@ class Raylet:
 
     def rpc_pg_cancel(self, conn: Connection, p):
         self._return_bundle(p["pg_id"], p["bundle_index"])
+
+    def rpc_pg_return_if_idle(self, conn: Connection, p):
+        """Repack-pass release: return the bundle ONLY if nothing uses or
+        is about to use it — the GCS plans migrations from its heartbeat
+        view, which can be a beat stale, so this raylet (the authority on
+        its own consumption) gates the actual release. Atomic within the
+        handler: the check and the return happen in one event-loop step."""
+        key = (p["pg_id"], p["bundle_index"])
+        b = self.pg_bundles.get(key)
+        if not b:
+            return {"ok": False, "reason": "unknown bundle"}
+        # consumed capacity: any named resource below its full reservation
+        for k, v in b["named"].items():
+            if self.resources_available.get(k, 0.0) < v - 1e-9:
+                return {"ok": False, "reason": "in use"}
+        # demand racing in: a queued/running task naming this pg's
+        # formatted resources would dispatch into the hole the migration
+        # leaves behind
+        named = set(b["named"])
+        for qt in list(self.ready) + list(self.waiting.values()) \
+                + list(self.running.values()) \
+                + list(self.infeasible.values()):
+            if named & set(qt.resources):
+                return {"ok": False, "reason": "queued demand"}
+        self._return_bundle(*key)
+        return {"ok": True}
 
     def rpc_pg_return(self, conn: Connection, p):
         self._return_bundle(p["pg_id"], p["bundle_index"])

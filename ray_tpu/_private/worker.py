@@ -241,6 +241,15 @@ class CoreWorker:
         # direct_task_transport.cc): per-scheduling-class pending queues,
         # one pump task per active class, cached conns to leased workers
         self._direct_q: Dict[tuple, deque] = {}
+        # direct-path placement latency (PR 6's raylet histogram only saw
+        # raylet-routed tasks): enqueue-on-the-direct-queue -> pushed to a
+        # leased worker, recorded as
+        # raylet_task_placement_latency_seconds{path="direct"} in THIS
+        # driver's registry (drivers ride the cluster scrape). Specs that
+        # fall back to raylet routing drop their stamp — the raylet's
+        # path="raylet" series takes over from its own ready queue.
+        self._direct_ready_at: Dict[bytes, float] = {}
+        self._direct_placement_lat = None
         # key -> live pump task; the TASK OBJECT is stored (strong ref, see
         # _bg_tasks note) and checked with .done() so a crashed/GC'd pump
         # self-heals on the next enqueue instead of stranding the class
@@ -503,8 +512,31 @@ class CoreWorker:
                 self._fail_returns(spec, f"task submission failed: {e}")
 
     # -- direct task push over worker leases ---------------------------
+    def _observe_direct_placement(self, batch):
+        """Stamp ready->push latency for direct-push specs (the direct
+        half of the two-path placement-latency histogram)."""
+        now = time.perf_counter()
+        hist = self._direct_placement_lat
+        if hist is None:
+            from ray_tpu._private import metrics_core as mc
+
+            hist = self._direct_placement_lat = mc.registry().histogram(
+                "raylet_task_placement_latency_seconds",
+                "Task ready to dispatched-to-worker, by dispatch path",
+                scale=mc.LATENCY,
+            ).labels(node=self.node_id[:12], path="direct")
+        for spec in batch:
+            t0 = self._direct_ready_at.pop(spec.task_id, None)
+            if t0 is not None:
+                hist.record(now - t0)
+
+    def _drop_direct_stamps(self, batch):
+        for spec in batch:
+            self._direct_ready_at.pop(spec.task_id, None)
+
     def _direct_enqueue(self, spec: TaskSpec):
         key = (tuple(sorted(spec.resources.items())), repr(spec.runtime_env))
+        self._direct_ready_at[spec.task_id] = time.perf_counter()
         self._direct_q.setdefault(key, deque()).append(spec)
         ev = self._direct_events.get(key)
         if ev is None:
@@ -540,6 +572,7 @@ class CoreWorker:
                 if not leases:
                     batch = list(q)
                     q.clear()
+                    self._drop_direct_stamps(batch)
                     try:
                         await self.raylet.request(
                             "submit_batch", {"specs": batch}
@@ -571,6 +604,7 @@ class CoreWorker:
                         and len(q) > cap):
                     tail = [q.pop() for _ in range(len(q) - cap)]
                     tail.reverse()
+                    self._drop_direct_stamps(tail)
                     try:
                         await self.raylet.request(
                             "submit_batch", {"specs": tail}
@@ -651,6 +685,7 @@ class CoreWorker:
                 # endpoint gone BEFORE anything was sent: the tasks never
                 # started, so reroute via the raylet without consuming a
                 # retry attempt (at-most-once was never at risk)
+                self._drop_direct_stamps(batch)
                 try:
                     await self.raylet.request(
                         "submit_batch", {"specs": batch}
@@ -665,6 +700,7 @@ class CoreWorker:
                 return
             for spec in batch:
                 self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
+            self._observe_direct_placement(batch)
             try:
                 # timeout=0 (unbounded): these awaits span the USER CODE's
                 # runtime — a deadline would falsely fail long tasks.

@@ -773,6 +773,44 @@ def cmd_serve_timeline(args):
         ray_tpu.shutdown()
 
 
+def cmd_schedsim(args):
+    """Deterministic scheduler simulator (schedsim.py): simulated
+    1k-10k-node clusters driving the REAL placement-scoring code paths
+    under a seeded virtual clock. No cluster needed — this is the
+    reproducible A/B surface every scheduling-policy PR reports against."""
+    from ray_tpu._private import schedsim
+
+    def one(policy: str) -> dict:
+        spec = schedsim.SimSpec(
+            nodes=args.nodes, policy=policy, seed=args.seed,
+            gangs=args.gangs, gang_size=args.gang_size,
+            strategy=args.strategy, chaos=args.chaos or "",
+        )
+        if args.trace:
+            report, trace = schedsim.run_with_trace(spec)
+            path = (args.trace if policy == args.policy
+                    else f"{args.trace}.{policy}")
+            with open(path, "w") as f:
+                f.write(trace)
+            report["trace_file"] = path
+            return report
+        return schedsim.run(spec)
+
+    if args.ab:
+        base = one("baseline")
+        cont = one("contention")
+        denom = base["total_contention"]
+        out = {
+            "baseline": base, "contention": cont,
+            "contention_vs_baseline_overlap_ratio": (
+                cont["total_contention"] / denom if denom else 0.0),
+        }
+    else:
+        out = one(args.policy)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
     from ray_tpu._private.perf import run_microbenchmarks
@@ -980,6 +1018,31 @@ def main(argv=None):
     p = sub.add_parser("summary", help="task summary by name")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser(
+        "schedsim",
+        help="deterministic scheduler simulator: policy A/B at simulated "
+             "1k-10k-node scale (no cluster needed)",
+    )
+    p.add_argument("--nodes", type=int, default=1000,
+                   help="simulated raylet count (default 1000)")
+    p.add_argument("--policy", choices=["contention", "baseline"],
+                   default="contention")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--gangs", type=int, default=0,
+                   help="gang arrivals (default nodes//40)")
+    p.add_argument("--gang-size", type=int, default=8)
+    p.add_argument("--strategy", default="STRICT_SPREAD",
+                   choices=["PACK", "SPREAD", "STRICT_PACK",
+                            "STRICT_SPREAD"])
+    p.add_argument("--chaos",
+                   help="faultsim rule syntax vs node ids (drop = node "
+                        "death, delay = heartbeat stall of param ms)")
+    p.add_argument("--trace", help="write the replayable event trace here")
+    p.add_argument("--ab", action="store_true",
+                   help="run BOTH policies and print the contention/"
+                        "baseline overlap ratio")
+    p.set_defaults(fn=cmd_schedsim)
 
     p = sub.add_parser(
         "microbenchmark",

@@ -115,6 +115,11 @@ def list_objects(filters: Optional[Iterable[Tuple]] = None,
 
 def list_placement_groups(filters: Optional[Iterable[Tuple]] = None,
                           limit: Optional[int] = None) -> List[dict]:
+    """PG table rows, including the gang scheduler's topology
+    provenance: ``node_coords`` (torus coord per bundle host),
+    ``contention_score`` (ring-overlap of the chosen placement vs gangs
+    committed before it), ``sched_strategy``
+    ("topology-contention" | "resource-fit"), and ``repack_moves``."""
     return _apply_filters(_gcs_request("pg_table", {}), filters, limit)
 
 

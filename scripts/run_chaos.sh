@@ -97,6 +97,16 @@ PYEOF
     else
         echo "(no live cluster for a serve requests dump)" >&2
     fi
+    # Gang-scheduler triage: the placement-group table with topology
+    # provenance (per-bundle torus coords, ring-overlap contention score,
+    # which scoring path placed it, repack migrations) from any reachable
+    # cluster — a chaos kill that strands a gang shows up here as a
+    # PENDING/INFEASIBLE row, and contention regressions as scores the
+    # schedsim lane can replay (ray_tpu schedsim --chaos ...).
+    echo "--- placement groups (coords + contention scores) ---" >&2
+    timeout -k 5 60 env JAX_PLATFORMS=cpu \
+        python -m ray_tpu list placement-groups >&2 2>/dev/null \
+        || echo "(no live cluster for a placement-group dump)" >&2
     # Log-plane triage: the cluster log listing plus the last error lines
     # of the streamed worker logs — what a driver would have seen — so a
     # crashed task's final output lands next to the failing lane's report.

@@ -458,8 +458,13 @@ def test_cluster_scrape_end_to_end(ray_start_regular):
         assert any(s["count"] > 0 for s in lat["series"])
         assert any(s["tags"].get("node")
                    for s in merged["raylet_ready_queue_depth"]["series"])
-        assert merged["raylet_task_placement_latency_seconds"]["series"][0][
-            "count"] > 0
+        # BOTH dispatch paths stamp placement latency now: the raylet's
+        # ready->dispatch series AND the driver-side direct-lease
+        # enqueue->push series, split by the path label
+        plat = merged["raylet_task_placement_latency_seconds"]
+        paths = {s["tags"].get("path")
+                 for s in plat["series"] if s["count"] > 0}
+        assert {"raylet", "direct"} <= paths, paths
         assert any(s["count"] > 0
                    for s in merged["object_store_put_bytes"]["series"])
         assert merged["worker_task_run_seconds"]["series"]

@@ -154,6 +154,71 @@ def test_wire_format_edge_cases_fall_back_consistently():
     assert place_bundles(nodes, [], "PACK") == place_bundles_py(nodes, [], "PACK")
 
 
+def test_place_bundles_parity_property_on_grid_resources():
+    """Property-style parity: on randomized grid-resource clusters (all
+    values on the engine's 1e-4 fixed-point grid), native and Python
+    place_bundles agree node-for-node across every strategy AND the
+    agreed placement is actually feasible and honors the strategy
+    (distinct nodes for STRICT_SPREAD, one node for STRICT_PACK, fits
+    under sequential reservation) — equality alone would also pass on
+    two identically-wrong engines."""
+    from ray_tpu._private.common import res_fits, res_sub
+
+    rng = random.Random(23)
+    checked = placed = 0
+    for trial in range(150):
+        nodes = _rand_cluster(rng, rng.randint(1, 10))
+        bundles = [
+            {"CPU": rng.choice([0.5, 1, 2]),
+             **({"TPU": rng.choice([1.0, 4.0])}
+                if rng.random() < 0.3 else {})}
+            for _ in range(rng.randint(1, 5))
+        ]
+        for strategy in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+            want = place_bundles_py(nodes, bundles, strategy)
+            got = native_sched.place_bundles(nodes, bundles, strategy)
+            assert got == want, (
+                f"trial {trial} {strategy}: native={got} py={want}"
+            )
+            checked += 1
+            if got is None:
+                continue
+            placed += 1
+            by_id = {n.node_id: n for n in nodes}
+            avail = {n.node_id: dict(n.resources_available)
+                     for n in nodes if n.alive}
+            for nid, b in zip(got, bundles):
+                assert by_id[nid].alive, (trial, strategy, got)
+                assert res_fits(b, avail[nid]), (trial, strategy, got)
+                res_sub(avail[nid], b)
+            if strategy == "STRICT_SPREAD":
+                assert len(set(got)) == len(got)
+            if strategy == "STRICT_PACK":
+                assert len(set(got)) == 1
+    assert checked == 600 and placed > 150
+
+
+def test_torus_coord_labels_stay_on_the_native_path():
+    """Topology labels in the canonical "x"-separated form must remain
+    wire-encodable — a cluster advertising coords must NOT silently fall
+    off the native pick_node fast path."""
+    from ray_tpu._private import topology
+    from ray_tpu._private.common import SchedulingStrategy, pick_node_py
+
+    nodes = [
+        NodeInfo(node_id=f"n{i}", host="h", port=0, store_dir="",
+                 resources_total={"CPU": 4}, resources_available={"CPU": 4},
+                 labels={topology.COORD_LABEL: topology.format_coord((i, 0)),
+                         topology.DIMS_LABEL: topology.format_coord((4, 1))})
+        for i in range(4)
+    ]
+    assert native_sched.encodable(nodes, {"CPU": 1}, SchedulingStrategy())
+    strat = SchedulingStrategy()
+    want = pick_node_py(nodes, {"CPU": 1}, strat, None, [0])
+    assert native_sched.pick_node(
+        nodes, {"CPU": 1}, strat, None, [0], 0.5) == want
+
+
 def test_build_scheduling_converts_node_label_strategy():
     from ray_tpu.api import _build_scheduling
     from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy

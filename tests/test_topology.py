@@ -1,0 +1,339 @@
+"""Torus topology model + contention-aware gang placement (topology.py).
+
+Pure-function tests over NodeInfo views: coordinate parsing, ring-link
+geometry, compactness, contention scoring, the topology-aware candidate
+search (which must inherit strategy semantics from the resource-fit
+oracle, never weaken them), and the fragmentation repack planner. The
+degrade contract — no coords advertised -> byte-identical to today's
+resource-fit path — is tested against place_bundles_py directly.
+"""
+
+import random
+
+import pytest
+
+from ray_tpu._private import topology
+from ray_tpu._private.common import (
+    NodeInfo,
+    place_bundles,
+    place_bundles_py,
+    res_fits,
+    res_sub,
+)
+
+pytestmark = pytest.mark.schedsim
+
+
+def make_node(nid, cpu=4.0, avail=None, coord=None, dims=None, labels=None):
+    labels = dict(labels or {})
+    if coord is not None:
+        labels[topology.COORD_LABEL] = topology.format_coord(coord)
+    if dims is not None:
+        labels[topology.DIMS_LABEL] = topology.format_coord(dims)
+    return NodeInfo(
+        node_id=nid, host="h", port=0, store_dir="",
+        resources_total={"CPU": cpu},
+        resources_available={"CPU": cpu if avail is None else avail},
+        labels=labels,
+    )
+
+
+def grid(nx, ny, cpu=4.0, prefix="n"):
+    return [
+        make_node(f"{prefix}{x}_{y}", cpu=cpu, coord=(x, y), dims=(nx, ny))
+        for y in range(ny) for x in range(nx)
+    ]
+
+
+def test_parse_and_format_coord():
+    assert topology.parse_coord("0x1") == (0, 1)
+    assert topology.parse_coord("0,1,2") == (0, 1, 2)  # legacy commas ok
+    assert topology.parse_coord("3") == (3,)
+    assert topology.parse_coord("") is None
+    assert topology.parse_coord("a,b") is None
+    assert topology.parse_coord("1x2x3x4") is None  # >3 dims
+    assert topology.format_coord((2, 0, 1)) == "2x0x1"
+    # the canonical form is wire-safe for the native scheduler
+    from ray_tpu._private.native_sched import _clean
+
+    assert _clean(topology.format_coord((1, 2, 3)))
+
+
+def test_from_nodes_requires_two_coords_and_infers_dims():
+    assert topology.Topology.from_nodes([make_node("a")]) is None
+    assert topology.Topology.from_nodes(
+        [make_node("a", coord=(0, 0)), make_node("b")]) is None
+    topo = topology.Topology.from_nodes(
+        [make_node("a", coord=(0, 0)), make_node("b", coord=(3, 1))])
+    assert topo is not None and topo.dims == (4, 2)  # inferred max+1
+    # explicit dims win when larger than observed
+    topo = topology.Topology.from_nodes(
+        [make_node("a", coord=(0, 0), dims=(8, 8)),
+         make_node("b", coord=(1, 0), dims=(8, 8))])
+    assert topo.dims == (8, 8)
+
+
+def test_ring_links_row_is_a_cycle():
+    nodes = grid(4, 4)
+    topo = topology.Topology.from_nodes(nodes)
+    row = [f"n{x}_0" for x in range(4)]
+    links = topo.ring_links(row)
+    # a full row of a 4-torus rings through the wraparound link
+    assert links == frozenset({
+        ((0, 0), (1, 0)), ((1, 0), (2, 0)), ((2, 0), (3, 0)),
+        ((0, 0), (3, 0)),
+    })
+    assert topo.ring_links(["n0_0"]) == frozenset()
+    assert topo.ring_links([]) == frozenset()
+
+
+def test_compactness_slice_vs_scatter_and_wraparound():
+    topo = topology.Topology.from_nodes(grid(4, 4))
+    row = [f"n{x}_0" for x in range(4)]
+    assert topo.compactness(row) == 1.0
+    scattered = ["n0_0", "n2_0", "n0_2", "n2_2"]
+    assert topo.compactness(scattered) > 1.0
+    # a block wrapping the torus edge is as compact as an interior one
+    interior = ["n1_0", "n2_0"]
+    wrapping = ["n0_0", "n3_0"]
+    assert topo.compactness(wrapping) == topo.compactness(interior)
+
+
+def test_contention_score_counts_shared_links():
+    topo = topology.Topology.from_nodes(grid(4, 4))
+    row0 = [f"n{x}_0" for x in range(4)]
+    row1 = [f"n{x}_1" for x in range(4)]
+    ring0 = topo.ring_links(row0)
+    assert topo.score(row1, {"g0": ring0}).contention == 0
+    assert topo.score(row0, {"g0": ring0}).contention == len(ring0)
+
+
+def test_link_capacity_weights_contention():
+    """torus-link-caps: a shared link on a half-capacity dimension
+    contends twice as hard; unit capacity degrades to a link count."""
+    nodes = [
+        make_node(f"n{x}_{y}", coord=(x, y), dims=(4, 4),
+                  labels={topology.LINK_CAPS_LABEL: "2x1"})
+        for y in range(4) for x in range(4)
+    ]
+    topo = topology.Topology.from_nodes(nodes)
+    assert topo.link_caps == (2.0, 1.0)
+    row = [f"n{x}_0" for x in range(4)]  # dim-0 links, capacity 2
+    col = [f"n0_{y}" for y in range(4)]  # dim-1 links, capacity 1
+    assert topo.score(row, {"g": topo.ring_links(row)}).contention == 2.0
+    assert topo.score(col, {"g": topo.ring_links(col)}).contention == 4.0
+
+
+def test_overlap_ratio_bounds():
+    topo = topology.Topology.from_nodes(grid(4, 4))
+    r0 = topo.ring_links([f"n{x}_0" for x in range(4)])
+    r1 = topo.ring_links([f"n{x}_1" for x in range(4)])
+    assert topo.overlap_ratio({}) == 0.0
+    assert topo.overlap_ratio({"a": r0}) == 0.0
+    assert topo.overlap_ratio({"a": r0, "b": r1}) == 0.0
+    assert topo.overlap_ratio({"a": r0, "b": r0}) == 1.0
+
+
+def test_place_bundles_topo_avoids_committed_ring():
+    nodes = grid(4, 4, cpu=4.0)
+    topo = topology.Topology.from_nodes(nodes)
+    row0 = [f"n{x}_0" for x in range(4)]
+    committed = {"g0": topo.ring_links(row0)}
+    # occupy row 0 so the oracle can't pick it anyway? No — leave it
+    # free: the scorer must avoid it by CHOICE, not by capacity.
+    placed = topology.place_bundles_topo(
+        nodes, [{"CPU": 4.0}] * 4, "STRICT_SPREAD", topo, committed)
+    assert placed is not None
+    placement, score = placed
+    assert score.contention == 0
+    assert not (topo.ring_links(placement) & committed["g0"])
+
+
+def test_place_bundles_topo_inherits_strategy_semantics():
+    rng = random.Random(5)
+    nodes = grid(6, 6, cpu=4.0)
+    for n in nodes:  # fragment the cluster
+        if rng.random() < 0.4:
+            n.resources_available = {"CPU": rng.choice([0.0, 1.0, 2.0])}
+    topo = topology.Topology.from_nodes(nodes)
+    for strategy in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        bundles = [{"CPU": rng.choice([1.0, 2.0])} for _ in range(4)]
+        placed = topology.place_bundles_topo(
+            nodes, bundles, strategy, topo, {})
+        oracle = place_bundles_py(nodes, bundles, strategy)
+        assert (placed is None) == (oracle is None), strategy
+        if placed is None:
+            continue
+        placement, _ = placed
+        assert_valid_placement(nodes, bundles, strategy, placement)
+
+
+def assert_valid_placement(nodes, bundles, strategy, placement):
+    """A placement honors the strategy and fits: shared validator used
+    by the topo tests here and the native-parity property test."""
+    by_id = {n.node_id: n for n in nodes}
+    assert len(placement) == len(bundles)
+    avail = {nid: dict(n.resources_available) for nid, n in by_id.items()}
+    for nid, b in zip(placement, bundles):
+        assert by_id[nid].alive
+        assert res_fits(b, avail[nid]), (nid, b, avail[nid])
+        res_sub(avail[nid], b)
+    if strategy == "STRICT_SPREAD":
+        assert len(set(placement)) == len(placement)
+    if strategy == "STRICT_PACK":
+        assert len(set(placement)) == 1
+
+
+def test_no_coords_degrades_to_resource_fit():
+    """The degrade contract: a topology-less cluster's place_bundles is
+    byte-identical to the oracle path (the wrapper must not even build
+    a Topology when none is passed)."""
+    nodes = [make_node(f"p{i}", cpu=4.0) for i in range(6)]
+    for strategy in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        bundles = [{"CPU": 2.0}] * 3
+        assert place_bundles(nodes, bundles, strategy) == \
+            place_bundles_py(nodes, bundles, strategy)
+    assert topology.Topology.from_nodes(nodes) is None
+
+
+def test_wrapper_threads_topology():
+    """common.place_bundles with topology= dispatches to the scorer."""
+    nodes = grid(4, 4, cpu=4.0)
+    topo = topology.Topology.from_nodes(nodes)
+    row0 = [f"n{x}_0" for x in range(4)]
+    committed = {"g0": topo.ring_links(row0)}
+    placement = place_bundles(
+        nodes, [{"CPU": 4.0}] * 4, "STRICT_SPREAD",
+        topology=topo, committed_rings=committed)
+    assert placement is not None
+    assert not (topo.ring_links(placement) & committed["g0"])
+
+
+def test_plan_repack_migrates_idle_bundle():
+    # n0 full (idle bundle), n1 free, n2 full (running), n3 big with room:
+    # strict-spread 3x4CPU needs 3 distinct nodes -> repack parks the
+    # idle bundle on the big node and frees n0.
+    nodes = [
+        make_node("n0", cpu=4.0, avail=0.0),
+        make_node("n1", cpu=4.0, avail=4.0),
+        make_node("n2", cpu=4.0, avail=0.0),
+        make_node("n3", cpu=8.0, avail=8.0),
+    ]
+    plan = topology.plan_repack(
+        nodes, [{"CPU": 4.0}] * 3, "STRICT_SPREAD",
+        [("pgA", 0, "n0", {"CPU": 4.0})])
+    assert plan is not None
+    placement, moves = plan
+    assert sorted(placement) == ["n0", "n1", "n3"]
+    assert len(moves) == 1 and moves[0].to_node == "n3"
+
+
+def test_plan_repack_gives_up_when_unsolvable():
+    # exact-fit cluster: moving the idle bundle anywhere just relocates
+    # the hole — the planner must return None, not livelock
+    nodes = [
+        make_node("n0", cpu=4.0, avail=0.0),
+        make_node("n1", cpu=4.0, avail=4.0),
+        make_node("n2", cpu=4.0, avail=0.0),
+        make_node("n3", cpu=4.0, avail=4.0),
+    ]
+    plan = topology.plan_repack(
+        nodes, [{"CPU": 4.0}] * 3, "STRICT_SPREAD",
+        [("pgA", 0, "n0", {"CPU": 4.0})])
+    assert plan is None
+
+
+def test_pg_table_carries_topology_provenance(ray_start_cluster):
+    """End to end on a real cluster advertising coords: the GCS places
+    gangs via the contention scorer, stamps node_coords /
+    contention_score / sched_strategy on the pg table, and the second
+    identical gang (forced onto the same nodes) records the ring overlap
+    the first one created."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table)
+
+    cluster = ray_start_cluster
+    dims = topology.format_coord((2, 2))
+    for c in ((0, 0), (1, 0), (0, 1), (1, 1)):
+        cluster.add_node(num_cpus=2, labels={
+            topology.COORD_LABEL: topology.format_coord(c),
+            topology.DIMS_LABEL: dims,
+        })
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = placement_group([{"CPU": 1.0}] * 4, strategy="STRICT_SPREAD")
+    assert pg.wait(60)
+    t = placement_group_table(pg)
+    assert t["sched_strategy"] == "topology-contention"
+    assert t["contention_score"] == 0.0
+    assert sorted(t["node_coords"]) == ["0x0", "0x1", "1x0", "1x1"]
+    assert t["repack_moves"] == 0
+
+    # same four nodes again: the second ring must overlap the first
+    pg2 = placement_group([{"CPU": 1.0}] * 4, strategy="STRICT_SPREAD")
+    assert pg2.wait(60)
+    t2 = placement_group_table(pg2)
+    assert t2["sched_strategy"] == "topology-contention"
+    assert t2["contention_score"] > 0.0
+
+
+def test_pg_return_if_idle_guards_consumed_bundles(ray_start_cluster):
+    """The repack pass's safety gate: the raylet releases a bundle only
+    when nothing consumes (or queues against) its reservation — the
+    GCS's heartbeat view may be a beat stale, so the raylet is the
+    authority."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.state import _node_request
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+
+    pg = placement_group([{"CPU": 1.0}], strategy="PACK")
+    assert pg.wait(60)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Holder:
+        def ping(self):
+            return 1
+
+    a = Holder.options(placement_group=pg,
+                       placement_group_bundle_index=0).remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+
+    from ray_tpu.util.placement_group import placement_group_table
+
+    t = placement_group_table(pg)
+    node = next(n for n in ray_tpu.nodes()
+                if n["node_id"] == t["bundle_nodes"][0])
+    busy = _node_request(node, "pg_return_if_idle",
+                         {"pg_id": pg.id_hex, "bundle_index": 0})
+    assert busy == {"ok": False, "reason": "in use"}
+
+    ray_tpu.kill(a)
+    import time as _t
+
+    deadline = _t.monotonic() + 20
+    while _t.monotonic() < deadline:
+        r = _node_request(node, "pg_return_if_idle",
+                          {"pg_id": pg.id_hex, "bundle_index": 0})
+        if r and r.get("ok"):
+            break
+        _t.sleep(0.2)
+    assert r == {"ok": True}
+    # released: a second conditional return finds nothing to release
+    r2 = _node_request(node, "pg_return_if_idle",
+                       {"pg_id": pg.id_hex, "bundle_index": 0})
+    assert r2 == {"ok": False, "reason": "unknown bundle"}
+
+
+def test_synthesize_coords_unique_and_sized():
+    coords = topology.synthesize(10)
+    assert len(coords) == len(set(coords)) == 10
+    coords = topology.synthesize(8, dims=(2, 2, 2))
+    assert len(set(coords)) == 8
+    assert all(len(c) == 3 for c in coords)
