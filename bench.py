@@ -744,6 +744,46 @@ def _object_plane_main():
     os._exit(0)
 
 
+def _control_plane_main():
+    """BENCH_CONTROL_PLANE=1: the control-plane fast-path lane — the two
+    sync roundtrip microbenchmarks (single-client tasks, 1:1 actor calls)
+    plus the per-stage latency breakdown of a call (envelope build, id
+    mint, submit rpc, lease wait, dispatch, result return) scraped from
+    the metrics-core histograms cluster-wide. Stage timing must be in the
+    environment BEFORE init so every spawned process inherits the clocks.
+    Reported value is the sync task ops/s (the row the fast-path levers
+    target); the gate is that the sync benches ran and the driver-side
+    stage histograms saw samples. Emits ONE JSON line, same contract as
+    the default bench path."""
+    os.environ["RAY_TPU_control_plane_stage_timing"] = "1"
+
+    import ray_tpu
+    from ray_tpu._private.perf import run_control_plane_bench
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    ray_tpu.init(num_cpus=2)
+    try:
+        rows = run_control_plane_bench(small=small)
+    finally:
+        ray_tpu.shutdown()
+    tasks_sync = next((r for r in rows
+                       if r["benchmark"] == "single client tasks sync"), {})
+    stage_rows = [r for r in rows if r["benchmark"].startswith("cp stage")]
+    driver_stages = ("cp stage id mint", "cp stage envelope build",
+                     "cp stage result return")
+    ok = (tasks_sync.get("value", 0.0) > 0
+          and all(r.get("value", 0) > 0 for r in stage_rows
+                  if r["benchmark"] in driver_stages))
+    print(json.dumps({
+        "metric": "control_plane_tasks_sync_ops_per_sec",
+        "value": tasks_sync.get("value", 0.0),
+        "unit": "ops/s",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": rows,
+    }), flush=True)
+    os._exit(0)
+
+
 def _schedsim_main():
     """BENCH_SCHEDSIM=1: the gang-scheduler acceptance lane — schedsim
     (deterministic discrete-event simulator over the REAL placement-
@@ -817,6 +857,8 @@ def main():
         _serve_load_main()
     if os.environ.get("BENCH_OBJECT_PLANE"):
         _object_plane_main()
+    if os.environ.get("BENCH_CONTROL_PLANE"):
+        _control_plane_main()
     if os.environ.get("BENCH_SCHEDSIM"):
         _schedsim_main()
 

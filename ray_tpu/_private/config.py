@@ -218,7 +218,33 @@ _flag("get_stall_dump_s", float, 30.0)
 _flag("direct_lease_pipeline_depth", int, 4)  # in-flight tasks per lease
 _flag("direct_lease_max", int, 16)  # leases per scheduling class per driver
 _flag("direct_lease_linger_s", float, 0.5)  # idle hold before lease return
+# grace-period return: after the class queue drains (and the feeders'
+# linger expires) the pump HOLDS its leases this long before returning
+# them, so the next burst rides the already-open lease conns with zero
+# raylet round trips. 0 restores return-on-drain (A/B lever).
+_flag("direct_lease_grace_s", float, 0.5)
 _flag("direct_push_batch_max", int, 64)  # specs per execute_task_batch frame
+# idle hold before a per-actor direct sender exits: a sync call loop
+# reuses the standing sender (and its pipelined conn) instead of paying
+# a task spawn + warm-up tick per call. 0 restores exit-on-drain.
+_flag("actor_sender_linger_s", float, 0.5)
+# submit_batch ack mode: "batch" = the raylet acks frame ACCEPTANCE and
+# schedules in the background (fire-and-forget lane; per-task failures
+# surface via the owner's task_result stream + task events), "spec" =
+# legacy ack-after-scheduling (A/B lever)
+_flag("submit_ack_mode", str, "batch")
+# control-plane stage timing (BENCH_CONTROL_PLANE): per-stage histograms
+# (envelope build, id mint, result return, submit->run) on the submit
+# path; off = one attr check per call
+_flag("control_plane_stage_timing", bool, False)
+# observability/GC debounce windows. A sync submit->get loop otherwise
+# generates one task_events notify (worker->raylet) and one free_objects
+# chain (driver->raylet->GCS) PER CALL — on a small box that background
+# traffic competes with the call's own round trip for CPU. Events/frees
+# buffer for the window and ship as one frame. 0 restores flush-per-tick
+# (A/B lever); exit paths still drain synchronously.
+_flag("task_events_flush_interval_s", float, 0.02)
+_flag("free_flush_interval_s", float, 0.005)
 # batch frames in flight per actor sender: >1 keeps the pipe full while the
 # next burst accumulates behind it (unbounded pipelining would drain the
 # queue one spec at a time and never form a batch)

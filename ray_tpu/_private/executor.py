@@ -155,8 +155,22 @@ class TaskExecutor:
             return {"error": f"{type(e).__name__}: {e}\n{tb}"}
 
     # ------------------------------------------------------------------
+    def _observe_submit_to_run(self, spec: TaskSpec):
+        """BENCH_CONTROL_PLANE dispatch stage: wall-clock gap between the
+        driver stamping the spec (TaskSpec.submit_time) and this worker
+        starting on it — submit RPC + lease/queue wait + dispatch in one
+        number (same-box clocks; the bench runs single-host)."""
+        dt = time.time() - spec.submit_time
+        if dt < 0:
+            return
+        from ray_tpu._private.worker import _stage_record
+
+        _stage_record("submit_to_run", dt)
+
     async def execute_task(self, spec: TaskSpec):
         t_in = time.perf_counter()
+        if cfg.control_plane_stage_timing:
+            self._observe_submit_to_run(spec)
         is_actor_task = spec.actor_id is not None and not spec.actor_creation
         sem = None
         if is_actor_task and (self._group_sems or spec.concurrency_group):
@@ -249,6 +263,9 @@ class TaskExecutor:
         loop = asyncio.get_running_loop()
         start = time.time()
         t_in = time.perf_counter()
+        if cfg.control_plane_stage_timing:
+            for s in specs:
+                self._observe_submit_to_run(s)
         gated = specs[0].actor_id is not None
         if gated:
             await self._await_turn(specs[0].caller_id, specs[0].seq_no)

@@ -167,3 +167,75 @@ class PlacementGroupID(BaseID):
     @classmethod
     def of(cls, job_id: JobID) -> "PlacementGroupID":
         return cls(os.urandom(PLACEMENT_GROUP_ID_SIZE - JOB_ID_SIZE) + job_id.binary())
+
+
+# --- submit hot path: block minting + raw wire forms -------------------
+# The per-call cost of ``TaskID.for_task(JobID(...))`` is an urandom
+# syscall plus two ID-object constructions (each with an isinstance/size
+# check and an eager hash); ``ObjectID.from_index`` adds an int.to_bytes
+# per return. The submit fast path (worker.submit_from_template) works in
+# raw bytes instead: TaskSpec.task_id is bytes on the wire anyway.
+
+_IDX_BYTES = tuple(
+    i.to_bytes(OBJECT_ID_INDEX_BYTES, "little") for i in range(256)
+)
+_CTR_BYTES = tuple(bytes((i,)) for i in range(256))
+
+
+def object_id_binary(task_binary: bytes, index: int) -> bytes:
+    """28-byte ObjectID wire form for the index-th return of a task (same
+    layout as ``ObjectID.from_index``) without intermediate ID objects."""
+    if index < 256:
+        return task_binary + _IDX_BYTES[index]
+    return task_binary + index.to_bytes(OBJECT_ID_INDEX_BYTES, "little")
+
+
+class TaskIDMinter:
+    """Amortized task-id minting: one ``os.urandom`` call covers a block
+    of ``BLOCK`` ids — a 7-byte random prefix plus a block-local counter
+    byte form the 8 unique bytes of a TaskID. One minter per (worker,
+    remote function / actor); the 16-byte suffix (nil-actor + job for
+    plain tasks, the actor id for actor tasks) is fixed at construction.
+
+    Uniqueness matches per-call minting: two blocks collide with
+    probability 2^-56, and ids within a block differ in the counter byte.
+
+    Thread safety: the whole block is pre-built as a list and handed out
+    via ``list.pop()`` (atomic under the GIL). Racing refills at block
+    exhaustion each draw their own random prefix, so ids are never
+    duplicated — at worst a partial block is abandoned."""
+
+    BLOCK = 64
+    __slots__ = ("_suffix", "_block")
+
+    def __init__(self, suffix: bytes):
+        if len(suffix) != ACTOR_ID_SIZE:
+            raise ValueError(
+                f"minter suffix must be {ACTOR_ID_SIZE} bytes, "
+                f"got {len(suffix)}"
+            )
+        self._suffix = bytes(suffix)
+        self._block: list = []
+
+    @classmethod
+    def for_job(cls, job_id: JobID) -> "TaskIDMinter":
+        return cls(b"\xff" * ACTOR_ID_UNIQUE_BYTES + job_id.binary())
+
+    @classmethod
+    def for_actor(cls, actor_id: ActorID) -> "TaskIDMinter":
+        return cls(actor_id.binary())
+
+    def next_binary(self) -> bytes:
+        """24-byte TaskID wire form; a fresh random block every BLOCK
+        calls. Blocks hand out ids in descending counter order (pop from
+        the tail is O(1)); order within a block carries no meaning."""
+        try:
+            return self._block.pop()
+        except IndexError:
+            prefix = os.urandom(TASK_ID_UNIQUE_BYTES - 1)
+            suffix = self._suffix
+            self._block = blk = [
+                prefix + _CTR_BYTES[i] + suffix
+                for i in range(self.BLOCK)
+            ]
+            return blk.pop()

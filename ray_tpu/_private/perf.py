@@ -418,3 +418,97 @@ def run_microbenchmarks(select: str = "", small: bool = False) -> List[dict]:
         print(f"no benchmarks matched --select {select!r}; available: "  # lint: allow-print
               + ", ".join(benches))
     return results
+
+
+# stage rows for the control-plane lane: display label -> (metric, label
+# filter). Remaining labels (node, path, ...) are merged — the lane reports
+# the cluster-wide distribution per stage, not per-node shards.
+_CP_STAGES = (
+    ("id mint", "control_plane_stage_seconds", {"stage": "id_mint"}),
+    ("envelope build", "control_plane_stage_seconds",
+     {"stage": "envelope_build"}),
+    ("submit rpc", "rpc_request_latency_seconds", {"method": "submit_batch"}),
+    ("lease wait", "rpc_request_latency_seconds",
+     {"method": "lease_workers"}),
+    ("dispatch (placement)", "raylet_task_placement_latency_seconds", None),
+    ("dispatch (execute rpc)", "rpc_request_latency_seconds",
+     {"method": "execute_task"}),
+    ("dispatch (batch rpc)", "rpc_request_latency_seconds",
+     {"method": "execute_task_batch"}),
+    ("submit->run", "control_plane_stage_seconds",
+     {"stage": "submit_to_run"}),
+    ("result return", "control_plane_stage_seconds",
+     {"stage": "result_return"}),
+)
+
+
+def run_control_plane_bench(small: bool = False) -> List[dict]:
+    """Control-plane lane (``BENCH_CONTROL_PLANE=1``): run the two
+    sync-roundtrip microbenchmarks (the rows the fast-path levers target),
+    then scrape the cluster-wide metrics snapshot and report the per-stage
+    latency breakdown of one call — envelope build, id mint, submit RPC,
+    lease wait, dispatch, result return — from the metrics-core histograms
+    every process already records. Requires
+    ``RAY_TPU_control_plane_stage_timing=1`` exported BEFORE init so the
+    driver, raylet and workers all inherit the stage clocks."""
+    from ray_tpu._private import metrics_core as mc
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    if not cfg.control_plane_stage_timing:
+        raise RuntimeError(
+            "control-plane bench needs RAY_TPU_control_plane_stage_timing=1 "
+            "in the environment before ray_tpu.init() — otherwise the stage "
+            "histograms this lane reads are never recorded")
+
+    rows: List[dict] = []
+    # substring select would also catch the *_async rows ("async" contains
+    # "sync"), so filter by exact registry key, one bench per pass
+    for sel in ("single_client_tasks_sync", "actor_calls_sync_1_1"):
+        rows.extend(run_microbenchmarks(select=sel, small=small))
+
+    from ray_tpu.util.metrics import cluster_snapshot
+
+    snap = cluster_snapshot().get("merged", {})
+
+    def stage_series(metric: str, want) -> dict:
+        """One mergeable series for (metric, label filter): series whose
+        tags match ``want`` are folded together across their remaining
+        labels (node, path, ...)."""
+        acc: dict = {}
+        for s in (snap.get(metric) or {}).get("series", ()):
+            tags = s.get("tags", {})
+            if want and any(tags.get(k) != v for k, v in want.items()):
+                continue
+            if not acc:
+                acc = {"buckets": list(s.get("buckets", ())),
+                       "boundaries": list(s.get("boundaries", ())),
+                       "count": s.get("count", 0),
+                       "sum": s.get("sum", 0.0)}
+            elif acc["boundaries"] == list(s.get("boundaries", ())):
+                acc["buckets"] = [a + b for a, b in
+                                  zip(acc["buckets"], s.get("buckets", ()))]
+                acc["count"] += s.get("count", 0)
+                acc["sum"] += s.get("sum", 0.0)
+        return acc
+
+    print(f"{'stage':<24s} {'calls':>8s} {'mean_us':>10s} "  # lint: allow-print
+          f"{'p50_us':>10s} {'p95_us':>10s} {'p99_us':>10s}")
+    for label, metric, want in _CP_STAGES:
+        s = stage_series(metric, want)
+        count = int(s.get("count", 0) or 0)
+        row = {"benchmark": f"cp stage {label}", "value": count,
+               "unit": "calls"}
+        if count:
+            qs = mc.hist_quantiles(s, (0.5, 0.95, 0.99))
+            row.update({"mean_us": round(s["sum"] / count * 1e6, 1),
+                        "p50_us": round(qs[0.5] * 1e6, 1),
+                        "p95_us": round(qs[0.95] * 1e6, 1),
+                        "p99_us": round(qs[0.99] * 1e6, 1)})
+            print(f"{label:<24s} {count:>8d} {row['mean_us']:>10,.1f} "  # lint: allow-print
+                  f"{row['p50_us']:>10,.1f} {row['p95_us']:>10,.1f} "
+                  f"{row['p99_us']:>10,.1f}")
+        else:
+            row["note"] = "no samples"
+            print(f"{label:<24s} {0:>8d}        (no samples)")  # lint: allow-print
+        rows.append(row)
+    return rows

@@ -168,18 +168,19 @@ def _tail_worker_log(w: _Worker, final: bool = False):
     if final and w.log_partial:
         lines_out.append((pos, w.log_partial))
         w.log_partial = b""
-    # One-tick hold for unattributed actor lines (closes the PR 7
-    # cosmetic race): a line printed before its task's RUNNING event
-    # reached this raylet used to take the actor-class fallback prefix
-    # (w.log_name) immediately. Fresh lines that resolve to no span on a
-    # worker that HAS a fallback name are instead carried to the next
-    # tick — by then the event has almost always landed and the method-
-    # name prefix wins. Order-preserving (everything after the first held
-    # line holds with it); carried lines always publish on their second
-    # look (resolved, or the class fallback for genuinely task-less
+    # One-tick hold for unattributed lines (closes the PR 7 cosmetic
+    # race, widened in PR 16): a line printed before its task's
+    # RUNNING/FINISHED event reached this raylet used to publish with
+    # the fallback prefix immediately. Worker-side task events are now
+    # debounced (task_events_flush_interval_s, 20ms default), so the
+    # window where log bytes exist but their span does not is real for
+    # EVERY worker, not just actors — fresh lines that resolve to no
+    # span are carried to the next tick (the tail interval, 0.3s,
+    # comfortably exceeds the debounce window, so the span has landed
+    # by the second look). Order-preserving (everything after the first
+    # held line holds with it); carried lines always publish on their
+    # second look (resolved, or the fallback for genuinely task-less
     # output), so the delay is bounded at one log_tail_interval_s.
-    # Workers with no fallback name keep publishing immediately — there
-    # is no wrong prefix to race against.
     held = getattr(w, "log_held", None) or []
     w.log_held = []
     n_held = len(held)
@@ -189,8 +190,7 @@ def _tail_worker_log(w: _Worker, final: bool = False):
         if not raw:
             continue
         name = w.log_spans.resolve(off)
-        if name is None and not final and i >= n_held \
-                and w.log_name is not None:
+        if name is None and not final and i >= n_held:
             w.log_held = [ln for ln in all_lines[i:] if ln[1]]
             break
         name = name or w.log_name
@@ -1395,16 +1395,54 @@ class Raylet:
         Actor tasks are enqueued synchronously BEFORE the first await:
         a mid-batch await would let the next batch frame's handler run
         and enqueue its actor tasks first, reordering a single actor's
-        calls across frames."""
+        calls across frames.
+
+        ack="batch" (fire-and-forget lane): the reply acks frame
+        ACCEPTANCE — scheduling proceeds in the background and the
+        driver's await no longer spans per-spec placement. Failures past
+        the ack surface exactly like failures past the legacy reply: via
+        the owner-routed task_result stream and the task-event plane."""
         rest = []
         for spec in p["specs"]:
             if spec.actor_id is not None and not spec.actor_creation:
                 self._enqueue_actor_task(spec, None)
             else:
                 rest.append(spec)
+        if p.get("ack") == "batch":
+            if rest:
+                t = asyncio.get_running_loop().create_task(
+                    self._schedule_batch(rest)
+                )
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
+            return {"accepted": len(p["specs"])}
         for spec in rest:
             await self._schedule_or_queue(spec)
         return {}
+
+    async def _schedule_batch(self, specs):
+        """Background half of the batched-ack lane. The submitter already
+        holds its ack, so a swallowed scheduling failure would hang its
+        get() forever — every per-spec error is converted into an
+        owner-routed task failure instead of a reply-path exception."""
+        for spec in specs:
+            try:
+                await self._schedule_or_queue(spec)
+            except Exception as e:  # noqa: BLE001
+                logger.exception(
+                    "background scheduling failed for %s",
+                    spec.task_id.hex()[:16],
+                )
+                try:
+                    await self._send_task_failure(
+                        spec, f"task scheduling failed: {e!r}",
+                        retriable=False,
+                    )
+                except Exception:
+                    logger.exception(
+                        "failed to surface scheduling failure for %s",
+                        spec.task_id.hex()[:16],
+                    )
 
     async def _actor_router(self, actor_id: bytes):
         """Drain one actor's routing queue sequentially (delivery order =

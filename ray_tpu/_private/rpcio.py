@@ -261,9 +261,13 @@ def _decode_v3(data: bytes):
 # duplicate awaits and re-sends the first execution's result. Bounded LRU:
 # old entries age out once the window where a retry could arrive is past.
 _IDEM_MAX = 4096
-_idem_results: "collections.OrderedDict[Any, asyncio.Future]" = (
-    collections.OrderedDict()
-)
+_idem_results: dict = {}
+# Claim-order ring beside the result dict: eviction pops from the left
+# instead of the old OrderedDict's move_to_end-per-hit plus a full
+# list() copy + scan once past the cap — O(1) amortized per claim (the
+# submit hot path pays this on every batched frame). Tokens forgotten
+# via _idem_forget leave a stale ring entry behind; eviction skips it.
+_idem_order: "collections.deque" = collections.deque()
 
 
 def _idem_claim(token) -> tuple:
@@ -271,21 +275,26 @@ def _idem_claim(token) -> tuple:
     resolve the future; non-owners await it."""
     fut = _idem_results.get(token)
     if fut is not None:
-        _idem_results.move_to_end(token)
         return fut, False
     fut = asyncio.get_running_loop().create_future()
     _idem_results[token] = fut
-    if len(_idem_results) > _IDEM_MAX:
-        # evict oldest COMPLETED entries only: an in-flight future guards
-        # an active execution — evicting it would let a concurrent retry
-        # claim ownership and double-execute, the exact failure this cache
-        # exists to prevent. Pending entries resolve and age out normally.
-        for key in list(_idem_results):
-            entry = _idem_results.get(key)
-            if entry is not None and entry.done():
-                del _idem_results[key]
-                if len(_idem_results) <= _IDEM_MAX:
-                    break
+    _idem_order.append(token)
+    # Evict oldest COMPLETED entries only: an in-flight future guards an
+    # active execution — evicting it would let a concurrent retry claim
+    # ownership and double-execute, the exact failure this cache exists
+    # to prevent. Pending entries rotate to the back; the bounded scan
+    # keeps a pathological all-pending cache from spinning this loop.
+    scans = 0
+    while len(_idem_order) > _IDEM_MAX and scans < 8:
+        scans += 1
+        old = _idem_order.popleft()
+        entry = _idem_results.get(old)
+        if entry is None:
+            continue  # forgotten: the ring entry was already stale
+        if entry.done():
+            del _idem_results[old]
+        else:
+            _idem_order.append(old)
     return fut, True
 
 
@@ -633,8 +642,10 @@ class Connection:
         in call order — the ordered-pipelining primitive direct actor
         calls ride on (a plain ``await request()`` per call would
         serialize to one call per RTT or lose ordering across tasks)."""
+        # hotpath: begin request_nowait (one frame per direct call — no
+        # per-call dict copies or string formatting off the error paths)
         if self._closed:
-            raise ConnectionLost(f"connection {self.name} closed",
+            raise ConnectionLost(f"connection {self.name} closed",  # lint: allow-hotpath (close error path)
                                  ) from self._close_error
         msg_id = next(self._msg_ids)
         # encode before registering the future: an oversized frame raises
@@ -652,6 +663,7 @@ class Connection:
         fut.add_done_callback(_done)
         self._enqueue_faulted(method, parts)
         return fut
+        # hotpath: end request_nowait
 
     async def _flush_writes(self):
         """Write every queued frame with ONE socket write per tick (frames

@@ -30,7 +30,8 @@ from ray_tpu._private import (faultsim, memview, object_store,
                               serialization, slab_arena)
 from ray_tpu._private.common import SchedulingStrategy, TaskSpec, rewrite_resources_for_pg
 from ray_tpu._private.config import GLOBAL_CONFIG as cfg
-from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID,
+                                  TaskIDMinter, WorkerID, object_id_binary)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpcio import (Connection, EventLoopThread, RpcServer,
                                     call_with_retries, connect)
@@ -56,12 +57,23 @@ class _deser_container:
         _DESER_CTX.container = self.prev
 
 
+_tracing_mod = None
+
+
 def _tracing_ctx():
     """Current span context for propagation into outgoing specs (no-op
-    None when tracing is off)."""
+    None when tracing is off). The tracing module is cached after the
+    first call: the per-call import machinery (sys.modules lookup plus
+    the from-list binding) is measurable on the submit hot path."""
+    global _tracing_mod
+    tracing = _tracing_mod
+    if tracing is None:
+        try:
+            from ray_tpu.util import tracing
+        except Exception:
+            return None
+        _tracing_mod = tracing
     try:
-        from ray_tpu.util import tracing
-
         if tracing.is_enabled():
             return tracing.current_context() or tracing.propagation_context()
         # Not locally enabled, but an adopted remote context still rides
@@ -69,6 +81,46 @@ def _tracing_ctx():
         return tracing.propagation_context()
     except Exception:
         return None
+
+
+# --- control-plane stage timing (BENCH_CONTROL_PLANE) ------------------
+# Gated on cfg.control_plane_stage_timing: the bench lane (and anyone
+# chasing a microsecond) gets per-stage latency histograms on the submit
+# path; the default path pays one attribute check per call. Per-stage
+# children are cached in a plain dict — same posture as rpcio._RpcMetrics.
+_STAGE_HISTS: Dict[str, Any] = {}
+
+
+def _stage_record(stage: str, seconds: float):
+    h = _STAGE_HISTS.get(stage)
+    if h is None:
+        from ray_tpu._private import metrics_core as mc
+
+        h = _STAGE_HISTS[stage] = mc.registry().histogram(
+            "control_plane_stage_seconds",
+            "Per-stage control-plane latency (see BENCH_CONTROL_PLANE)",
+            scale=mc.LATENCY,
+        ).labels(stage=stage)
+    h.record(seconds)
+
+
+class TaskTemplate:
+    """Immutable per-callsite submit template (control-plane fast path):
+    everything about a ``.remote()`` call that does not vary call to call
+    — resources (PG-rewritten once), scheduling, the serialized function,
+    retry policy, runtime env — is computed ONCE here, so the per-call
+    path only mints a task id and encodes the arguments. The API layer
+    caches one template per RemoteFunction / actor method; ``.options()``
+    yields a new options set and therefore a new template, and ``worker``
+    pins the CoreWorker the template was built against so a reconnect
+    invalidates the cache. The resources/scheduling objects are SHARED
+    across every spec stamped from the template and must not be mutated
+    driver-side (the raylet unpickles its own copies)."""
+
+    __slots__ = ("worker", "name", "func_blob", "method_name",
+                 "num_returns", "resources", "scheduling", "max_retries",
+                 "retry_exceptions", "runtime_env", "actor_id",
+                 "concurrency_group", "minter")
 
 
 def _log_span_fields(result: dict) -> dict:
@@ -113,6 +165,7 @@ class CoreWorker:
         namespace: Optional[str] = None,
     ):
         self.client_id = WorkerID.from_random().hex()
+        self._caller_id = self.client_id.encode()  # spec-stamp fast path
         # chaos identity (faultsim partition rules match on it): drivers
         # and workers are labeled so raylet-to-raylet partitions miss them
         faultsim.set_self_id(f"worker:{self.client_id[:12]}")
@@ -496,14 +549,23 @@ class CoreWorker:
         self._submit_flushing = False
         if not batch:
             return
+        payload = {"specs": batch}
+        if cfg.submit_ack_mode == "batch":
+            # fire-and-forget lane: the raylet acks frame ACCEPTANCE and
+            # schedules in the background; per-task failures surface via
+            # the owner's task_result stream + task events, so this await
+            # no longer spans per-spec scheduling
+            payload["ack"] = "batch"
         try:
-            # retried with backoff; the idem token (first task id is unique
-            # to this batch) keeps a retry whose original actually landed
-            # from enqueueing every spec twice
+            # retried with backoff; the idem token is keyed on the FULL
+            # frame (first, last, len): a frame is identified by its exact
+            # spec run, so a retry never aliases a different batch that
+            # merely shares its head (the old first-spec-only key deduped
+            # a grown/regrouped retry frame wrong)
             await call_with_retries(
-                lambda: self.raylet, "submit_batch", {"specs": batch},
-                idem=("submit_batch", batch[0].task_id, batch[0].attempt,
-                      len(batch)),
+                lambda: self.raylet, "submit_batch", payload,
+                idem=("submit_batch", batch[0].task_id, batch[-1].task_id,
+                      len(batch), batch[0].attempt),
             )
             for spec in batch:
                 self._submit_stage[spec.task_id] = "raylet_accepted"
@@ -548,28 +610,54 @@ class CoreWorker:
 
     async def _direct_pump(self, key: tuple):
         """One pump per scheduling class: lease workers from the raylet,
-        fan feeders over the leases, return the leases when the class
-        queue drains. Zero grants (no local capacity / feature off on the
-        raylet) falls back to raylet-routed submission, which spills
-        across nodes as usual."""
+        fan feeders over the leases, and HOLD the leases across bursts —
+        when the class queue drains, the pump keeps its grant warm for
+        direct_lease_grace_s (grace-period return) so a sequential
+        submit→get loop's next call rides the already-open lease conns
+        with zero raylet round trips instead of re-leasing per burst.
+        Each burst tops the grant up toward the queue-depth ask (lease
+        prefetch: the held leases are already in hand before the lease
+        RPC for the delta returns). Zero grants (no local capacity /
+        feature off on the raylet) falls back to raylet-routed
+        submission, which spills across nodes as usual."""
         q = self._direct_q[key]
+        held: List[dict] = []
         try:
-            while q:
+            while True:
+                if not q:
+                    if not held or cfg.direct_lease_grace_s <= 0:
+                        break
+                    # grace window: keep the grant warm for the next burst
+                    ev = self._direct_events[key]
+                    ev.clear()
+                    if q:  # a spec landed between the check and the clear
+                        continue
+                    try:
+                        await asyncio.wait_for(
+                            ev.wait(), cfg.direct_lease_grace_s
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    continue
                 spec0 = q[0]
                 depth = cfg.direct_lease_pipeline_depth
                 want = min(cfg.direct_lease_max,
                            max(1, (len(q) + depth - 1) // depth))
-                try:
-                    reply = await self.raylet.request(
-                        "lease_workers",
-                        {"resources": dict(spec0.resources),
-                         "runtime_env": spec0.runtime_env,
-                         "job_id": self.job_id, "count": want},
-                    )
-                    leases = reply.get("leases") or []
-                except Exception:
-                    leases = []
-                if not leases:
+                spillable = False
+                if len(held) < want:
+                    try:
+                        reply = await self.raylet.request(
+                            "lease_workers",
+                            {"resources": dict(spec0.resources),
+                             "runtime_env": spec0.runtime_env,
+                             "job_id": self.job_id,
+                             "count": want - len(held)},
+                        )
+                        held.extend(reply.get("leases") or [])
+                        spillable = bool(reply.get("spillable"))
+                    except Exception:
+                        pass
+                if not held:
                     batch = list(q)
                     q.clear()
                     self._drop_direct_stamps(batch)
@@ -597,10 +685,10 @@ class CoreWorker:
                 # workers via the slow path — either way the queue stays
                 # on the direct pipelines, where feeders amortize via
                 # spec batching and the pump re-leases next iteration.
-                cap = len(leases) * depth * 8
-                local_limit = (len(leases) < want
+                cap = len(held) * depth * 8
+                local_limit = (len(held) < want
                                or want >= cfg.direct_lease_max)
-                if (local_limit and reply.get("spillable")
+                if (local_limit and spillable
                         and len(q) > cap):
                     tail = [q.pop() for _ in range(len(q) - cap)]
                     tail.reverse()
@@ -616,11 +704,16 @@ class CoreWorker:
                             self._fail_returns(
                                 s, f"task submission failed: {e}"
                             )
-                loop = asyncio.get_running_loop()
                 ev = self._direct_events[key]
+                # one LINGERING feeder per lease; the rest exit on drain.
+                # A sync call loop then pays one event wakeup per call
+                # instead of a thundering herd of `depth` waiters, while
+                # burst capacity (depth in-flight per lease) is restored
+                # by the pump respawning the full fan on the next round.
                 feeders = [
-                    self._spawn(self._direct_feed(lease, q, ev))
-                    for lease in leases for _ in range(depth)
+                    self._spawn(self._direct_feed(lease, q, ev,
+                                                  linger=(j == 0)))
+                    for lease in held for j in range(depth)
                 ]
                 # return_exceptions: one crashed feeder must not kill the
                 # pump before the leases are returned — a dead pump strands
@@ -631,14 +724,14 @@ class CoreWorker:
                     if isinstance(res, BaseException):
                         logger.error("direct feeder crashed: %r", res,
                                      exc_info=res)
-                for lease in leases:
-                    try:
-                        await self.raylet.notify(
-                            "return_lease", {"lease_id": lease["lease_id"]}
-                        )
-                    except Exception:
-                        pass
         finally:
+            for lease in held:
+                try:
+                    await self.raylet.notify(
+                        "return_lease", {"lease_id": lease["lease_id"]}
+                    )
+                except Exception:
+                    pass
             self._direct_pumps.pop(key, None)
             if q:  # a burst landed during the finally window: restart
                 self._direct_pumps[key] = self._spawn(self._direct_pump(key))
@@ -658,10 +751,16 @@ class CoreWorker:
         self._direct_conns[ep] = conn
         return conn
 
-    async def _direct_feed(self, lease: dict, q: deque, ev: asyncio.Event):
+    async def _direct_feed(self, lease: dict, q: deque, ev: asyncio.Event,
+                           linger: bool = True):
         conn = await self._direct_conn(lease)
+        # hotpath: begin direct_feed (per-spec stamps are precomputed —
+        # no per-call string formatting on the steady-state push path)
+        pushed_stage = "pushed:%d" % lease["port"]
         while True:
             if not q:
+                if not linger:
+                    return  # non-lingering feeder: exit on drain
                 # linger: a sequential submit-get loop reuses the standing
                 # lease (2 hops/call) instead of re-leasing per call
                 ev.clear()
@@ -695,12 +794,13 @@ class CoreWorker:
                 except Exception as e:
                     for spec in batch:
                         self._fail_returns(
-                            spec, f"task submission failed: {e}"
+                            spec, f"task submission failed: {e}"  # lint: allow-hotpath (reroute error path)
                         )
                 return
             for spec in batch:
-                self._submit_stage[spec.task_id] = f"pushed:{lease['port']}"
+                self._submit_stage[spec.task_id] = pushed_stage
             self._observe_direct_placement(batch)
+            # hotpath: end direct_feed
             try:
                 # timeout=0 (unbounded): these awaits span the USER CODE's
                 # runtime — a deadline would falsely fail long tasks.
@@ -761,9 +861,10 @@ class CoreWorker:
             spec.actor_id,
             {"q": deque(), "running": False, "conn": None,
              "fallback": False, "inflight": 0, "relost": [],
-             "settled": asyncio.Event()},
+             "settled": asyncio.Event(), "wake": asyncio.Event()},
         )
         st["q"].append(spec)
+        st["wake"].set()  # rouse a lingering sender
         if not st["running"]:
             st["running"] = True
             self._spawn(self._actor_sender(spec.actor_id, st))
@@ -787,7 +888,25 @@ class CoreWorker:
         await asyncio.sleep(0)
         loop = asyncio.get_running_loop()
         try:
-            while st["q"] or st["relost"]:
+            while True:
+                if not (st["q"] or st["relost"]):
+                    # linger on drain: a sync call loop reuses this sender
+                    # (and its pipelined conn + warm-up tick) instead of
+                    # paying a task spawn per call; the enqueue path sets
+                    # st["wake"] to rouse it
+                    if cfg.actor_sender_linger_s <= 0:
+                        return
+                    wake = st["wake"]
+                    wake.clear()
+                    if st["q"] or st["relost"]:
+                        continue  # raced an enqueue between check and clear
+                    try:
+                        await asyncio.wait_for(
+                            wake.wait(), cfg.actor_sender_linger_s
+                        )
+                    except asyncio.TimeoutError:
+                        return  # finally respawns if an enqueue raced this
+                    continue
                 if st["fallback"]:
                     # collect every outcome before rerouting so the raylet
                     # sees the calls in seq order
@@ -1029,6 +1148,145 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
+    def task_template(
+        self,
+        func=None,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        scheduling: Optional[SchedulingStrategy] = None,
+        max_retries: int = 3,
+        retry_exceptions: bool = False,
+        name: str = "",
+        func_blob: Optional[bytes] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> TaskTemplate:
+        """Build the immutable submit template for a plain-task callsite:
+        the constant half of submit_task, paid once per (RemoteFunction,
+        options, worker) instead of per call."""
+        import cloudpickle
+
+        t = TaskTemplate()
+        t.worker = self
+        scheduling = scheduling or SchedulingStrategy()
+        res = dict(resources if resources is not None else {"CPU": 1.0})
+        if scheduling.kind == "PLACEMENT_GROUP":
+            res = rewrite_resources_for_pg(
+                res, scheduling.pg_id, scheduling.pg_bundle_index
+            )
+        t.resources = res
+        t.scheduling = scheduling
+        t.name = name or getattr(func, "__name__", "task")
+        t.func_blob = (func_blob if func_blob is not None
+                       else cloudpickle.dumps(func))
+        t.method_name = None
+        t.num_returns = num_returns
+        t.max_retries = max_retries
+        t.retry_exceptions = retry_exceptions
+        t.runtime_env = runtime_env
+        t.actor_id = None
+        t.concurrency_group = None
+        t.minter = TaskIDMinter.for_job(JobID(self.job_id))
+        return t
+
+    def actor_task_template(
+        self,
+        actor_id: bytes,
+        method_name: str,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+        concurrency_group: Optional[str] = None,
+    ) -> TaskTemplate:
+        """Submit template for one actor method callsite (the constant
+        half of submit_actor_task)."""
+        t = TaskTemplate()
+        t.worker = self
+        t.name = method_name
+        t.method_name = method_name
+        t.func_blob = None
+        t.num_returns = num_returns
+        t.resources = {}
+        t.scheduling = None
+        t.max_retries = max_task_retries
+        t.retry_exceptions = False
+        t.runtime_env = None
+        t.actor_id = actor_id
+        t.concurrency_group = concurrency_group
+        t.minter = TaskIDMinter.for_actor(ActorID(actor_id))
+        return t
+
+    # hotpath: begin submit (lint_hotpath: no per-call dict( copies or
+    # f-string id minting — constant work belongs in the template)
+    def submit_from_template(self, tmpl: TaskTemplate, args,
+                             kwargs) -> List[ObjectRef]:
+        """Per-call half of plain-task submission: mint an id from the
+        template's block minter, encode the arguments, stamp the spec."""
+        timed = cfg.control_plane_stage_timing
+        t0 = time.perf_counter() if timed else 0.0
+        task_id = tmpl.minter.next_binary()
+        if timed:
+            _stage_record("id_mint", time.perf_counter() - t0)
+        pins: List = []
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=tmpl.name,
+            func_blob=tmpl.func_blob,
+            method_name=None,
+            num_returns=tmpl.num_returns,
+            resources=tmpl.resources,
+            scheduling=tmpl.scheduling,
+            owner=self.addr,
+            max_retries=tmpl.max_retries,
+            retry_exceptions=tmpl.retry_exceptions,
+            caller_id=self._caller_id,
+            runtime_env=tmpl.runtime_env,
+            tracing_ctx=_tracing_ctx(),
+        )
+        refs = self._register_returns(spec)
+        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
+        if timed:
+            _stage_record("envelope_build", time.perf_counter() - t0)
+        return refs
+
+    def submit_actor_from_template(self, tmpl: TaskTemplate, args,
+                                   kwargs) -> List[ObjectRef]:
+        """Per-call half of actor-task submission: mint, stamp the seq,
+        encode, enqueue."""
+        timed = cfg.control_plane_stage_timing
+        t0 = time.perf_counter() if timed else 0.0
+        task_id = tmpl.minter.next_binary()
+        if timed:
+            _stage_record("id_mint", time.perf_counter() - t0)
+        actor_id = tmpl.actor_id
+        with self._lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+        pins: List = []
+        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=tmpl.name,
+            func_blob=None,
+            method_name=tmpl.method_name,
+            num_returns=tmpl.num_returns,
+            resources=tmpl.resources,
+            owner=self.addr,
+            actor_id=actor_id,
+            max_retries=tmpl.max_retries,
+            seq_no=seq,
+            caller_id=self._caller_id,
+            tracing_ctx=_tracing_ctx(),
+            concurrency_group=tmpl.concurrency_group,
+        )
+        refs = self._register_returns(spec)
+        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
+        if timed:
+            _stage_record("envelope_build", time.perf_counter() - t0)
+        return refs
+    # hotpath: end submit
+
     def submit_task(
         self,
         func,
@@ -1043,54 +1301,37 @@ class CoreWorker:
         func_blob: Optional[bytes] = None,
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
-        import cloudpickle
-
-        task_id = TaskID.for_task(JobID(self.job_id))
-        scheduling = scheduling or SchedulingStrategy()
-        resources = dict(resources if resources is not None else {"CPU": 1.0})
-        if scheduling.kind == "PLACEMENT_GROUP":
-            resources = rewrite_resources_for_pg(
-                resources, scheduling.pg_id, scheduling.pg_bundle_index
-            )
-        pins: List = []
-        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
-        spec = TaskSpec(
-            task_id=task_id.binary(),
-            job_id=self.job_id,
-            name=name or getattr(func, "__name__", "task"),
-            func_blob=func_blob if func_blob is not None else cloudpickle.dumps(func),
-            method_name=None,
-            num_returns=num_returns,
-            resources=resources,
-            scheduling=scheduling,
-            owner=self.addr,
-            max_retries=max_retries,
-            retry_exceptions=retry_exceptions,
-            caller_id=self.client_id.encode(),
-            runtime_env=runtime_env,
-            tracing_ctx=_tracing_ctx(),
+        """One-shot submission (no callsite cache): builds a throwaway
+        template. The API layer's RemoteFunction caches the template and
+        calls submit_from_template directly."""
+        tmpl = self.task_template(
+            func=func, num_returns=num_returns, resources=resources,
+            scheduling=scheduling, max_retries=max_retries,
+            retry_exceptions=retry_exceptions, name=name,
+            func_blob=func_blob, runtime_env=runtime_env,
         )
-        refs = self._register_returns(spec)
-        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
-        return refs
+        return self.submit_from_template(tmpl, args, kwargs)
 
+    # hotpath: begin register_returns
     def _register_returns(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = []
-        task_id = TaskID(spec.task_id)
+        task_binary = spec.task_id
+        addr = self.addr
         # dynamic (-1): one visible return — the ref-list; item objects are
         # adopted at result time (rpc_task_result dynamic_return_oids)
         n = 1 if spec.num_returns == -1 else spec.num_returns
         with self._lock:
-            self._specs_inflight[spec.task_id] = spec
+            self._specs_inflight[task_binary] = spec
             for i in range(n):
-                oid = ObjectID.from_index(task_id, i + 1)
+                ob = object_id_binary(task_binary, i + 1)
                 fut = concurrent.futures.Future()
-                self._futures[oid.binary()] = fut
-                self._owned.add(oid.binary())
-                refs.append(ObjectRef(oid, self.addr))
+                self._futures[ob] = fut
+                self._owned.add(ob)
+                refs.append(ObjectRef(ObjectID(ob), addr))
         for r in refs:
             self.add_local_ref(r)
         return refs
+    # hotpath: end register_returns
 
     # -- actors ---------------------------------------------------------
     def create_actor(
@@ -1224,31 +1465,14 @@ class CoreWorker:
         max_task_retries: int = 0,
         concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
-        task_id = TaskID.for_actor_task(ActorID(actor_id))
-        with self._lock:
-            seq = self._actor_seq.get(actor_id, 0)
-            self._actor_seq[actor_id] = seq + 1
-        pins: List = []
-        enc_args, enc_kwargs, pending = self._encode_slots(args, kwargs, pins)
-        spec = TaskSpec(
-            task_id=task_id.binary(),
-            job_id=self.job_id,
-            name=method_name,
-            func_blob=None,
-            method_name=method_name,
-            num_returns=num_returns,
-            resources={},
-            owner=self.addr,
-            actor_id=actor_id,
-            max_retries=max_task_retries,
-            seq_no=seq,
-            caller_id=self.client_id.encode(),
-            tracing_ctx=_tracing_ctx(),
+        """One-shot actor submission (no callsite cache); ActorMethod
+        caches a template and calls submit_actor_from_template directly."""
+        tmpl = self.actor_task_template(
+            actor_id, method_name, num_returns=num_returns,
+            max_task_retries=max_task_retries,
             concurrency_group=concurrency_group,
         )
-        refs = self._register_returns(spec)
-        self._enqueue_submit(spec, enc_args, enc_kwargs, pending, pins)
-        return refs
+        return self.submit_actor_from_template(tmpl, args, kwargs)
 
     def get_actor_table(self, actor_id: Optional[bytes] = None,
                         name: Optional[str] = None, namespace: Optional[str] = None):
@@ -1321,6 +1545,8 @@ class CoreWorker:
             await self.rpc_task_result(conn, p)
 
     async def rpc_task_result(self, conn: Connection, p):
+        t0 = (time.perf_counter()
+              if cfg.control_plane_stage_timing else 0.0)
         task_id: bytes = p["task_id"]
         with self._lock:
             spec = self._specs_inflight.get(task_id)
@@ -1333,7 +1559,6 @@ class CoreWorker:
         self._submit_stage.pop(task_id, None)
         with self._lock:
             self._specs_inflight.pop(task_id, None)
-        tid = TaskID(task_id)
         # num_returns="dynamic": adopt ownership of the item objects BEFORE
         # the ref-list materializes (deserializing it registers refs, which
         # must find their oids in _owned), record their lineage so a lost
@@ -1347,7 +1572,7 @@ class CoreWorker:
         # have been freed, leaking escape pins.
         exec_node = (p.get("exec_addr") or (None,))[0]
         if dyn_oids and spec is not None:
-            list_oid = ObjectID.from_index(tid, 1).binary()
+            list_oid = object_id_binary(task_id, 1)
             tokens = []
             for oid in dyn_oids:
                 with self._lock:
@@ -1360,15 +1585,18 @@ class CoreWorker:
                 self._resolve_plasma(oid)
             with self._lock:
                 self._contains.setdefault(list_oid, []).extend(tokens)
+        # hotpath: begin task_result_resolve (raw oid binaries — no ID
+        # object churn on the per-result resolve path)
         for i, res in enumerate(results):
-            oid = ObjectID.from_index(tid, i + 1)
+            ob = object_id_binary(task_id, i + 1)
             if res[0] == "v":
-                self._resolve_inline(oid.binary(), res[1], res[2])
+                self._resolve_inline(ob, res[1], res[2])
             else:
                 # the stored return lives on the executing node: record it
                 # in the owner directory before anyone asks
-                self._record_owned_location(oid.binary(), exec_node)
-                self._resolve_plasma(oid.binary())
+                self._record_owned_location(ob, exec_node)
+                self._resolve_plasma(ob)
+        # hotpath: end task_result_resolve
         if spec is not None and any(r[0] == "r" for r in results):
             self._record_lineage(spec)
         # Borrower handoff, ordered so an object is always pinned somewhere:
@@ -1384,7 +1612,7 @@ class CoreWorker:
             nested_map = p.get("returns_nested") or {}
             if nested_map:
                 for i, nested in nested_map.items():
-                    roid = ObjectID.from_index(tid, int(i) + 1).binary()
+                    roid = object_id_binary(task_id, int(i) + 1)
                     await self._adopt_contains(roid, nested)
                 await self._owner_call(
                     exec_addr, "release_return_pins", {"task_id": task_id}
@@ -1393,7 +1621,9 @@ class CoreWorker:
             self._release_task_pins(task_id)
         # Returns whose refs were already dropped can be freed now.
         for i in range(len(results)):
-            self._maybe_free(ObjectID.from_index(tid, i + 1).binary())
+            self._maybe_free(object_id_binary(task_id, i + 1))
+        if t0:
+            _stage_record("result_return", time.perf_counter() - t0)
 
     async def _register_borrow_for(self, oid: bytes, owner, borrower: tuple):
         """Register ``borrower`` with ``oid``'s owner (us or remote)."""
@@ -1843,7 +2073,14 @@ class CoreWorker:
             self._spawn(self._flush_task_events())
 
     async def _flush_task_events(self):
-        await asyncio.sleep(0)  # one tick: coalesce same-burst events
+        # debounced: a sync call loop emits RUNNING + FINISHED per call on
+        # separate ticks — flush-per-tick ships ~2 notify frames per call
+        # to the raylet. Buffering for the window coalesces a whole run of
+        # calls into one frame; the raylet batches onward to the GCS on
+        # its own timer, and exit paths (rpc_exit /
+        # flush_task_events_sync) still drain immediately.
+        dt = cfg.task_events_flush_interval_s
+        await asyncio.sleep(dt if dt > 0 else 0)
         buf, self._tev_buf = self._tev_buf, []
         self._tev_flushing = False
         if not buf:
@@ -2896,7 +3133,14 @@ class CoreWorker:
                 self._free_flushing = False
 
     async def _flush_frees(self):
-        await asyncio.sleep(0)  # one tick: coalesce same-burst frees
+        # debounced: a sequential get loop drops one ref per call, and a
+        # flush-per-tick turns that into a free_objects chain (driver ->
+        # raylet -> GCS) per call competing with the calls themselves for
+        # CPU; the window batches them into one frame. Frees are refcount
+        # GC — nothing awaits them — so the only cost is pages staying
+        # pinned for the window.
+        dt = cfg.free_flush_interval_s
+        await asyncio.sleep(dt if dt > 0 else 0)
         buf, self._free_buf = self._free_buf, []
         self._free_flushing = False
         if not buf:

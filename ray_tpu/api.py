@@ -459,6 +459,7 @@ class RemoteFunction:
         self._function = func
         self._options = options
         self._func_blob = cloudpickle.dumps(func)
+        self._template = None  # per-callsite submit template (lazy)
         self.__name__ = getattr(func, "__name__", "remote_function")
         self.__doc__ = getattr(func, "__doc__", None)
 
@@ -475,24 +476,24 @@ class RemoteFunction:
         rf._function = self._function
         rf._options = merged
         rf._func_blob = self._func_blob
+        rf._template = None  # new options set -> new template
         rf.__name__ = self.__name__
         rf.__doc__ = self.__doc__
         return rf
 
-    def remote(self, *args, **kwargs):
-        global_worker.check_connected()
+    def _build_template(self, cw):
+        """Resolve options into a CoreWorker submit template — the
+        constant per-call work (resource/scheduling translation, runtime
+        env packaging) paid once per (RemoteFunction, options, worker)."""
         opts = self._options
-        cw = global_worker.core_worker
         num_returns = opts.get("num_returns", 1)
         if num_returns == "dynamic":
             # ray parity: num_returns="dynamic" — the single visible ref
             # resolves to a list of per-item ObjectRefs (task_manager.h
             # ObjectRefStream / legacy dynamic generators)
             num_returns = -1
-        refs = cw.submit_task(
-            self._function,
-            args=args,
-            kwargs=kwargs,
+        return cw.task_template(
+            func=self._function,
             num_returns=num_returns,
             resources=_build_resources(opts, default_cpu=1.0),
             scheduling=_build_scheduling(opts),
@@ -502,9 +503,25 @@ class RemoteFunction:
             func_blob=self._func_blob,
             runtime_env=_prepare_runtime_env(opts.get("runtime_env")),
         )
-        if num_returns in (1, -1):  # -1 = dynamic: one visible ref
+
+    def remote(self, *args, **kwargs):
+        global_worker.check_connected()
+        cw = global_worker.core_worker
+        tmpl = self._template
+        if tmpl is None or tmpl.worker is not cw:
+            # first call, new options, or a reconnect swapped the worker
+            tmpl = self._template = self._build_template(cw)
+        refs = cw.submit_from_template(tmpl, args, kwargs)
+        if tmpl.num_returns in (1, -1):  # -1 = dynamic: one visible ref
             return refs[0]
         return refs
+
+    def __getstate__(self):
+        # a RemoteFunction captured in a task closure ships by value; the
+        # template pins the local CoreWorker and must never ride along
+        state = self.__dict__.copy()
+        state["_template"] = None
+        return state
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import FunctionNode
@@ -524,6 +541,7 @@ class ActorMethod:
         self._name = name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        self._template = None  # per-method submit template (lazy)
 
     def options(self, **opts):
         num_returns = opts.get("num_returns", self._num_returns)
@@ -540,9 +558,15 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(
-            self._name, args, kwargs, num_returns=self._num_returns,
-            concurrency_group=self._concurrency_group,
+            self, args, kwargs
         )
+
+    def __getstate__(self):
+        # the template pins the local CoreWorker: never serialized (an
+        # unpickled method rebuilds it lazily on first .remote())
+        state = self.__dict__.copy()
+        state["_template"] = None
+        return state
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ClassMethodNode
@@ -569,28 +593,29 @@ class ActorHandle:
         self._method_groups = method_groups or {}
         self._concurrency_groups = concurrency_groups or {}
 
-    def _invoke(self, method_name, args, kwargs, num_returns=1,
-                concurrency_group=None):
+    def _invoke(self, method: "ActorMethod", args, kwargs):
         global_worker.check_connected()
         cw = global_worker.core_worker
-        group = concurrency_group or self._method_groups.get(method_name)
-        if group is not None and self._concurrency_groups and (
-            group not in self._concurrency_groups
-        ):
-            raise ValueError(
-                f"concurrency group {group!r} not declared on this actor "
-                f"(declared: {sorted(self._concurrency_groups)})"
+        tmpl = method._template
+        if tmpl is None or tmpl.worker is not cw:
+            group = (method._concurrency_group
+                     or self._method_groups.get(method._name))
+            if group is not None and self._concurrency_groups and (
+                group not in self._concurrency_groups
+            ):
+                raise ValueError(
+                    f"concurrency group {group!r} not declared on this actor "
+                    f"(declared: {sorted(self._concurrency_groups)})"
+                )
+            tmpl = method._template = cw.actor_task_template(
+                self._actor_id,
+                method._name,
+                num_returns=method._num_returns,
+                max_task_retries=self._max_task_retries,
+                concurrency_group=group,
             )
-        refs = cw.submit_actor_task(
-            self._actor_id,
-            method_name,
-            args=args,
-            kwargs=kwargs,
-            num_returns=num_returns,
-            max_task_retries=self._max_task_retries,
-            concurrency_group=group,
-        )
-        if num_returns == 1:
+        refs = cw.submit_actor_from_template(tmpl, args, kwargs)
+        if method._num_returns == 1:
             return refs[0]
         return refs
 
@@ -601,10 +626,16 @@ class ActorHandle:
         # convention for internal remote methods (e.g. _rt_init_collective).
         if name.startswith("_") and not name.startswith("_rt_"):
             raise AttributeError(name)
-        return ActorMethod(
+        method = ActorMethod(
             self, name, num_returns=self._methods.get(name, 1),
             concurrency_group=self._method_groups.get(name),
         )
+        # memoize on the instance: later `handle.<name>` lookups hit the
+        # instance dict directly (no __getattr__, no fresh ActorMethod per
+        # call) and reuse the method's cached submit template. __reduce__
+        # rebuilds handles from ids, so the cache never rides a pickle.
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({ActorID(self._actor_id).hex()[:16]})"

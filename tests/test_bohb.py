@@ -8,6 +8,8 @@ import statistics
 from ray_tpu import tune
 from ray_tpu.tune.search import BOHBSearcher, ExternalSearcherAdapter
 
+import pytest
+
 
 def _multi_fidelity_objective(cfg, budget):
     """Score improves with budget; the config's quality dominates at high
@@ -154,6 +156,7 @@ def test_external_adapter_worked_example():
     assert adapter2.suggest("t0") == Searcher.FINISHED
 
 
+@pytest.mark.slow
 def test_bohb_with_tuner_and_hb_scheduler(ray_start_regular):
     """End-to-end: Tuner + HyperBandForBOHB + BOHBSearcher converge on a
     seeded objective."""

@@ -173,6 +173,11 @@ def test_tail_worker_log_attributes_by_offset(tmp_path):
     a_start = data.index(b"from-task-a")
     a_end = data.index(b"after")
     w.log_spans.close_span("ta", "task_a", a_start, a_end)
+    # first look holds: the batch starts with an unresolved fresh line
+    # ("pre") and worker-side task events are debounced, so unattributed
+    # fresh lines wait one tail tick for their span to land
+    entry, stats = _tail_worker_log(w)
+    assert entry is None and stats["lines"] == 0
     entry, stats = _tail_worker_log(w)
     assert stats["lines"] == 4 and stats["truncated"] == 0
     assert entry["pid"] == 4242
@@ -198,6 +203,10 @@ def test_tail_worker_log_budget_and_truncation(tmp_path):
     try:
         cfg.update({"log_publish_max_bytes": 64 * 1024,
                     "log_max_line_bytes": 50})
+        # first look holds the fresh unresolved batch (span-less lines
+        # wait one tick); the second look publishes it
+        entry, stats = _tail_worker_log(w)
+        assert entry is None and stats["lines"] == 0
         entry, stats = _tail_worker_log(w)
         # bounded per tick: well under the whole file, lines length-capped
         assert 0 < stats["lines"] < 2000

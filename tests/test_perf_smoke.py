@@ -98,6 +98,51 @@ def test_object_plane_ratio_floors(object_plane_rows):
 
 
 # ----------------------------------------------------------------------
+# control-plane stage lane (BENCH_CONTROL_PLANE): per-stage latency
+# breakdown of the submit->lease->dispatch fast path
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def control_plane_rows(ray_start_regular):
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+    from ray_tpu._private.perf import run_control_plane_bench
+
+    prev = cfg.control_plane_stage_timing
+    cfg.update({"control_plane_stage_timing": True})
+    try:
+        rows = run_control_plane_bench(small=True)
+    finally:
+        cfg.update({"control_plane_stage_timing": prev})
+    return {r["benchmark"]: r for r in rows}
+
+
+def test_control_plane_lane_reports_driver_stages(control_plane_rows):
+    rows = control_plane_rows
+    # the lane must produce the two sync headline rows AND samples for
+    # every driver-side stage (a silent zero here means the stage timers
+    # fell off the hot path and the breakdown is lying)
+    assert rows["single client tasks sync"]["value"] > 0, rows
+    assert rows["1:1 actor calls sync"]["value"] > 0, rows
+    for stage in ("cp stage id mint", "cp stage envelope build",
+                  "cp stage result return"):
+        assert rows[stage]["value"] > 0, rows
+
+
+def test_control_plane_constant_stages_stay_constant(control_plane_rows):
+    rows = control_plane_rows
+    # ratio floors on the amortized-constant stages: id minting is a
+    # list.pop of precomputed bytes (healthy ~2us mean) and envelope
+    # build a template clone (healthy ~60us). Caps sit ~10x over healthy
+    # so only a structural regression (f-string ids, per-call dict copies
+    # re-introduced) trips them, not box noise.
+    mint = rows["cp stage id mint"].get("mean_us", 0)
+    build = rows["cp stage envelope build"].get("mean_us", 0)
+    assert 0 < mint < 200, rows["cp stage id mint"]
+    assert 0 < build < 2000, rows["cp stage envelope build"]
+
+
+# ----------------------------------------------------------------------
 # cross-node transfer plane (arena-to-arena): push/pull floors between
 # two real nodes. ONE test so the 2-node cluster + bench matrix run
 # once; function-scoped own cluster — LAST in the module so the

@@ -122,6 +122,7 @@ def test_ppo_learns_cartpole(ray_start_regular):
     assert best >= 100, f"PPO failed to learn CartPole (best={best})"
 
 
+@pytest.mark.slow
 def test_impala_improves(ray_start_regular):
     algo = (
         IMPALAConfig()
@@ -176,6 +177,7 @@ def test_algorithm_checkpoint_roundtrip(ray_start_regular):
     algo2.stop()
 
 
+@pytest.mark.slow
 def test_tune_over_algorithm(ray_start_regular):
     """rllib Algorithms are Tune trainables (ray parity: Tuner("PPO"))."""
     from ray_tpu import tune
@@ -305,6 +307,7 @@ def test_bc_clones_expert(ray_start_regular, tmp_path):
     assert score > 50, score
 
 
+@pytest.mark.slow
 def test_appo_learns_cartpole(ray_start_regular):
     from ray_tpu.rllib import APPOConfig
 
@@ -325,6 +328,7 @@ def test_appo_learns_cartpole(ray_start_regular):
     assert best >= 100, f"APPO failed to learn CartPole (best={best})"
 
 
+@pytest.mark.slow
 def test_runner_death_recovers(ray_start_regular):
     """Killing an env-runner actor mid-training is absorbed: the algorithm
     replaces it and keeps training (ray parity: FaultTolerantActorManager,

@@ -87,6 +87,7 @@ def test_ppo_with_filter_learns_and_syncs(ray_start_regular):
     assert best >= 100, f"filtered PPO failed to learn (best={best})"
 
 
+@pytest.mark.slow
 def test_eval_workers_run_on_interval(ray_start_regular):
     algo = (
         PPOConfig()

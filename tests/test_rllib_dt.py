@@ -43,6 +43,7 @@ def test_episode_split_and_rtg(tmp_path):
         assert ep["rtg"][1] == pytest.approx(float(ep["actions"][1]))
 
 
+@pytest.mark.slow
 def test_dt_return_conditioning(tmp_path):
     path = _chain_dataset(str(tmp_path / "data.json"))
     cfg = (

@@ -33,6 +33,7 @@ def _client_env_loop(address: str, episodes: int, out: dict):
     out["returns"] = returns
 
 
+@pytest.mark.slow
 def test_policy_server_end_to_end(ray_start_regular):
     algo = (
         DQNConfig()

@@ -18,6 +18,7 @@ def _train_until(algo, key, threshold, iters):
     return best
 
 
+@pytest.mark.slow
 def test_pg_learns_cartpole(ray_start_regular):
     from ray_tpu.rllib import PGConfig
 
@@ -33,6 +34,7 @@ def test_pg_learns_cartpole(ray_start_regular):
     assert best >= 60.0, best
 
 
+@pytest.mark.slow
 def test_a2c_learns_cartpole(ray_start_regular):
     from ray_tpu.rllib import A2CConfig
 
@@ -187,6 +189,7 @@ def test_cql_beats_random(ray_start_regular, expert_dataset):
     assert score > 50, score
 
 
+@pytest.mark.slow
 def test_cql_regularizer_lowers_unseen_q(ray_start_regular, expert_dataset):
     """The CQL term must push logsumexp(Q) toward the logged action's Q —
     with alpha>0 the gap shrinks vs alpha=0 over the same updates."""

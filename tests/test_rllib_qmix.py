@@ -51,6 +51,7 @@ def test_mixer_monotonic_in_agent_qs():
         assert float(qi[0]) >= float(q0[0]) - 1e-6  # dQtot/dq_a >= 0
 
 
+@pytest.mark.slow
 def test_qmix_solves_two_step_game(ray_cluster):
     cfg = (
         QMIXConfig()

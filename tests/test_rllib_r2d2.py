@@ -61,6 +61,7 @@ def test_sequence_buffer_roundtrip():
     assert set(np.unique(mb["x"])) <= {2.0, 3.0, 4.0, 5.0}
 
 
+@pytest.mark.slow
 def test_r2d2_solves_memory_task(ray_cluster):
     cfg = (
         R2D2Config()

@@ -4,6 +4,8 @@ rllib/tests/run_regression_tests.py driven in CI)."""
 import subprocess
 import sys
 
+import pytest
+
 
 def test_all_configs_load_and_declare_thresholds():
     """Every tuned example must parse, name a known algorithm config,
@@ -27,6 +29,7 @@ def test_all_configs_load_and_declare_thresholds():
         assert "training_iteration" in stop, name
 
 
+@pytest.mark.slow
 def test_run_regression_single_config_end_to_end():
     out = subprocess.run(
         [sys.executable, "-m", "ray_tpu.rllib.run_regression",

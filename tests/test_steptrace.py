@@ -435,14 +435,20 @@ def test_tailer_falls_back_after_one_tick(tmp_path):
     assert stats["lines"] == 1
 
 
-def test_tailer_publishes_unnamed_workers_immediately(tmp_path):
+def test_tailer_holds_unnamed_worker_lines_one_tick(tmp_path):
+    # worker-side task events are debounced (task_events_flush_interval_s),
+    # so even a plain task worker's lines can reach the tailer before
+    # their span: unresolved fresh lines hold one tick for every worker,
+    # then publish with whatever attribution arrived (here: none)
     from ray_tpu._private.raylet import _tail_worker_log
 
     path = tmp_path / "plain.out"
-    path.write_bytes(b"no fallback to race\n")
+    path.write_bytes(b"task-less chatter\n")
     w = _FakeWorker(path, log_name=None)
     entry, stats = _tail_worker_log(w)
-    assert entry["segs"] == [[None, ["no fallback to race"]]]
+    assert entry is None and stats["lines"] == 0
+    entry, stats = _tail_worker_log(w)
+    assert entry["segs"] == [[None, ["task-less chatter"]]]
 
 
 def test_tailer_final_flushes_held_lines(tmp_path):

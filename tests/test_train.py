@@ -301,6 +301,7 @@ def test_sklearn_trainer(ray_start_regular):
     assert model.predict(np.array([[2.0, 2.0, 0.0]]))[0] == 1
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_convergence(ray_start_regular):
     """Convergence (not just collectives): a 2-worker DDP regression run
     must actually minimize the loss, with gradient averaging across the
