@@ -342,6 +342,18 @@ _flag("tune_experiment_snapshot_period_s", float, 10.0)
 # Train (ray: train/_internal/backend_executor timeouts)
 _flag("train_worker_start_timeout_s", float, 300.0)
 _flag("train_result_poll_timeout_s", float, 900.0)
+# Train fault tolerance (gang supervision + checkpointed recovery)
+# interval between liveness pings / health polls of the worker gang
+_flag("train_health_check_interval_s", float, 1.0)
+# a rank that reports no step progress for this long is declared wedged
+# (0 disables the progress watchdog; only liveness pings run)
+_flag("train_progress_timeout_s", float, 0.0)
+# master switch: tear down + re-place + restore-from-checkpoint on failure
+# (off -> legacy behavior: surface the error to the trainer retry loop)
+_flag("train_recovery_enabled", bool, True)
+# SIGTERM drain: how long a worker may run past the signal to reach the
+# next step boundary and checkpoint before it hard-exits
+_flag("train_drain_grace_s", float, 30.0)
 
 
 GLOBAL_CONFIG = _Config()

@@ -25,6 +25,11 @@ Spec syntax (``RAY_TPU_RPC_FAULTS``), rules separated by ``;`` or newlines::
              declares the peer dead). ``prob`` is ignored — a matching
              partition rule is always on (a real partition is not a coin
              flip per packet).
+  kill       SIGKILL the process sending a matching frame — rank death
+             as a seeded-replayable chaos event (nothing flushes, no
+             handlers run: exactly what a spot reclaim or OOM kill looks
+             like to the rest of the gang). Match a method only the
+             target process sends (or replies to) to scope the blast.
 
 ``pattern`` is a regex matched against the RPC *method name* for frame
 kinds, and against ``"<self_id>><peer>"`` for ``partition`` (so a rule
@@ -62,7 +67,7 @@ from typing import List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-KINDS = ("drop", "delay", "dup", "corrupt", "partition")
+KINDS = ("drop", "delay", "dup", "corrupt", "partition", "kill")
 
 _FILE_POLL_S = 0.2
 

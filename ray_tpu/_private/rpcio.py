@@ -609,6 +609,12 @@ class Connection:
                 faultsim.record_injection(kind, method)
                 if kind == "partition":
                     return None
+                if kind == "kill":
+                    # rank death, not graceful exit: no flush, no atexit —
+                    # the gang's supervisor must detect this, not be told
+                    import signal as _signal
+
+                    os.kill(os.getpid(), _signal.SIGKILL)
                 if kind == "dup":
                     self._enqueue_frame(parts)
                 elif kind == "delay":

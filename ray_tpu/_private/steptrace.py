@@ -204,6 +204,23 @@ def record_compile(name: str, start: float, end: float, first: bool):
     _idx += 1
 
 
+def record_restart(cause: str, start: float, end: float, generation: int):
+    """One gang recovery interval (detection -> new generation ready),
+    recorded by the driver-side BackendExecutor. ``cause`` is the failure
+    classification (actor_died / wedged / drain / error); ``generation``
+    is the gang generation that STARTED at ``end``."""
+    global _events, _idx
+    if not _enabled:
+        return
+    ring = _ring_slot()
+    if ring is None:
+        return
+    _events += 1
+    ring[_idx % _ring_size] = ("restart", _idx, cause, int(generation),
+                               start, end)
+    _idx += 1
+
+
 def _record_step(step: int, start: float, end: float):
     global _events, _idx
     ring = _ring_slot()
@@ -369,6 +386,10 @@ def snapshot() -> List[dict]:
             out.append({"kind": "compile", "idx": rec[1], "name": rec[2],
                         "first": rec[3], "rank": rec[4], "start": rec[5],
                         "end": rec[6]})
+        elif kind == "restart":
+            out.append({"kind": "restart", "idx": rec[1], "cause": rec[2],
+                        "generation": rec[3], "start": rec[4],
+                        "end": rec[5]})
     return out
 
 
@@ -465,6 +486,7 @@ def merge_records(records: Sequence[dict]) -> Dict[str, Any]:
     phases: List[dict] = []
     steps: List[dict] = []
     compiles: List[dict] = []
+    restarts: List[dict] = []
     for rec in records:
         kind = rec.get("kind")
         if kind == "coll":
@@ -475,14 +497,18 @@ def merge_records(records: Sequence[dict]) -> Dict[str, Any]:
             steps.append(rec)
         elif kind == "compile":
             compiles.append(rec)
+        elif kind == "restart":
+            restarts.append(rec)
     phases.sort(key=lambda r: r["start"])
     steps.sort(key=lambda r: r["start"])
     compiles.sort(key=lambda r: r["start"])
+    restarts.sort(key=lambda r: r["start"])
     return {
         "collectives": merge_collectives(colls),
         "phases": phases,
         "steps": steps,
         "compiles": compiles,
+        "restarts": restarts,
     }
 
 
@@ -558,6 +584,21 @@ def chrome_trace(merged: Dict[str, Any]) -> List[dict]:
             "dur": max((rec["end"] - rec["start"]) * 1e6, 1.0),
             "pid": rec["rank"], "tid": "compile",
             "args": {"first_call": bool(rec.get("first"))},
+        })
+    restarts = merged.get("restarts", ())
+    if restarts:
+        trace.append({"name": "process_name", "ph": "M", "pid": -1,
+                      "args": {"name": "driver (recovery)"}})
+    for rec in restarts:
+        trace.append({
+            "name": f"restart[{rec['cause']}] -> gen {rec['generation']}",
+            "cat": "restart", "ph": "X",
+            "ts": rec["start"] * 1e6,
+            "dur": max((rec["end"] - rec["start"]) * 1e6, 1.0),
+            "pid": -1, "tid": "recovery",
+            "args": {"cause": rec["cause"],
+                     "generation": rec["generation"],
+                     "recovery_s": rec["end"] - rec["start"]},
         })
     return trace
 

@@ -58,6 +58,33 @@ def main():
     atexit.register(_flush_observability, cw)
 
     def _on_sigterm(signum, frame):
+        # Spot preemption drain: a train worker with an active session
+        # checkpoints at its next step boundary and exits cleanly (the
+        # executor requeues the gang WITHOUT spending failure budget).
+        # A grace timer bounds how long we run past the signal; workers
+        # with no training in flight keep the immediate-exit behavior.
+        sess_mod = sys.modules.get("ray_tpu.train.session")
+        if sess_mod is not None:
+            try:
+                accepted = sess_mod.request_drain()
+            except Exception:
+                accepted = False
+            if accepted:
+                try:
+                    from ray_tpu._private.config import GLOBAL_CONFIG
+
+                    grace = float(GLOBAL_CONFIG.train_drain_grace_s)
+                except Exception:
+                    grace = 30.0
+
+                def _grace_exit():
+                    _flush_observability(cw)
+                    os._exit(0)
+
+                t = threading.Timer(grace, _grace_exit)
+                t.daemon = True
+                t.start()
+                return
         _flush_observability(cw)
         os._exit(0)
 

@@ -8,7 +8,9 @@ from ray_tpu.air.config import (
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend import (Backend, BackendConfig, JaxConfig,
                                    TensorflowConfig, TorchConfig)
-from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.backend_executor import (BackendExecutor,
+                                            FailureBudgetExhaustedError,
+                                            TrainingFailedError)
 from ray_tpu.train.session import (get_checkpoint, get_context,
                                    get_dataset_shard, report, step_phase)
 from ray_tpu.train.trainer import (
@@ -38,6 +40,7 @@ __all__ = [
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
+    "FailureBudgetExhaustedError",
     "FailureConfig",
     "JaxConfig",
     "JaxTrainer",

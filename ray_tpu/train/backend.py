@@ -110,9 +110,12 @@ class _JaxBackend(Backend):
         if n > 1:
             from ray_tpu.util import collective as col
 
+            # epoch = gang generation: a recovery re-placement must not
+            # rendezvous against the dead generation's KV state
             col.create_collective_group(
                 worker_group.workers, n, list(range(n)),
                 backend="store", group_name="train_dp",
+                epoch=getattr(worker_group, "generation", 0),
             )
 
 

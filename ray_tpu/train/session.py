@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import steptrace
@@ -74,6 +75,11 @@ class _Session:
         self.loaded_checkpoint = loaded_checkpoint
         self.stop_requested = threading.Event()
         self.dataset_shards: Dict[str, Any] = {}
+        # gang-supervision surface: progress heartbeat for the driver-side
+        # watchdog (stamped at every report) and the SIGTERM drain latch
+        self.drain_requested = threading.Event()
+        self.step_count = 0
+        self.last_progress = time.monotonic()
 
 
 _session: Optional[_Session] = None
@@ -104,6 +110,31 @@ def get_session() -> Optional[_Session]:
     return _session
 
 
+def request_drain() -> bool:
+    """Ask the active session to drain: checkpoint at the next step boundary
+    (the next ``report()``) and exit cleanly. Returns whether a session was
+    there to accept — the SIGTERM handler falls back to immediate exit when
+    no training is in flight."""
+    s = _session
+    if s is None:
+        return False
+    s.drain_requested.set()
+    return True
+
+
+def health() -> Dict[str, Any]:
+    """Progress snapshot for the driver-side gang watchdog."""
+    s = _session
+    if s is None:
+        return {"active": False}
+    return {
+        "active": True,
+        "step": s.step_count,
+        "since_progress_s": time.monotonic() - s.last_progress,
+        "draining": s.drain_requested.is_set(),
+    }
+
+
 def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
     """ray parity: ray.train.report — ship metrics (+ checkpoint) to the
     driver. Outside a session, a no-op with the metrics returned for
@@ -114,6 +145,8 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
     # step observatory: a report IS the natural step boundary — close the
     # current step interval and open the next (steptrace no-ops when off)
     steptrace.step_mark()
+    s.step_count += 1
+    s.last_progress = time.monotonic()
     payload = {"type": "report", "metrics": dict(metrics)}
     if checkpoint is not None:
         # Materialize to a directory so the driver (possibly another node)
@@ -122,7 +155,15 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
             checkpoint._data if checkpoint._data is not None else None
         )
         payload["checkpoint_path"] = checkpoint._path
+    draining = s.drain_requested.is_set()
+    if draining:
+        # spot preemption: this report is the step boundary the drain was
+        # waiting for — tag it so the executor requeues WITHOUT burning a
+        # failure-budget slot, then exit the loop cleanly
+        payload["drain"] = True
     s.queue.put(payload)
+    if draining:
+        raise SystemExit("drain requested (preemption)")
     if s.stop_requested.is_set():
         raise SystemExit("training stop requested")
 

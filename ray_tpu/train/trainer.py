@@ -133,10 +133,18 @@ class DataParallelTrainer(BaseTrainer):
         return out
 
     def fit(self) -> Result:
+        from ray_tpu.train.backend_executor import FailureBudgetExhaustedError
+
         result = self._fit_impl()
         failure_cfg = self.run_config.failure_config
         retries = failure_cfg.max_failures
-        while result.error is not None and retries != 0:
+        # Gang failures (rank death, wedge) are recovered IN-PLACE by the
+        # BackendExecutor against the same budget; a budget-exhausted
+        # outcome is terminal and must not be retried from scratch here.
+        # This outer loop remains the from-scratch fallback for
+        # application errors, which the in-place path does not retry.
+        while (result.error is not None and retries != 0
+               and not isinstance(result.error, FailureBudgetExhaustedError)):
             retries -= 1
             result = self._fit_impl()
         if result.error is not None and self.run_config.failure_config.fail_fast:

@@ -41,11 +41,28 @@ class TrainWorker:
         """Run an arbitrary callable on the worker (backend setup hooks)."""
         return fn(*args, **kwargs)
 
-    def _rt_init_collective(self, world_size, rank, backend, group_name):
+    def _rt_init_collective(self, world_size, rank, backend, group_name,
+                            epoch=0):
         from ray_tpu.util import collective as col
 
-        col.init_collective_group(world_size, rank, backend, group_name)
+        col.init_collective_group(world_size, rank, backend, group_name,
+                                  epoch=epoch)
         return rank
+
+    def ping(self):
+        """Liveness probe: a dead worker raises ActorDiedError at the
+        caller; a live one answers immediately (the gang is created with
+        max_concurrency>1 so this never queues behind next_result)."""
+        return True
+
+    def health(self):
+        """Progress snapshot for the executor's per-step watchdog."""
+        return session_mod.health()
+
+    def request_drain(self):
+        """Preemption notice: checkpoint at the next step boundary and
+        exit cleanly (same path the worker's SIGTERM handler takes)."""
+        return session_mod.request_drain()
 
     def start_training(self, train_fn: Callable, config: dict):
         assert self._session is not None, "setup_session must run first"
@@ -81,13 +98,16 @@ class TrainWorker:
         return True
 
     def next_result(self, timeout: float = 300.0):
-        """Block for the next report/done/error from the train loop."""
+        """Block for the next report/done/error from the train loop. An
+        empty poll piggybacks the session health snapshot so the
+        executor's watchdog sees per-rank step progress without a second
+        RPC round."""
         import queue as _q
 
         try:
             return self._session.queue.get(timeout=timeout)
         except _q.Empty:
-            return {"type": "timeout"}
+            return {"type": "timeout", "health": session_mod.health()}
 
     def request_stop(self):
         if self._session:
@@ -101,13 +121,21 @@ class TrainWorker:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: dict,
-                 placement_group=None, runtime_env: Optional[dict] = None):
+                 placement_group=None, runtime_env: Optional[dict] = None,
+                 generation: int = 0):
         from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
         self.num_workers = num_workers
+        # gang generation: 0 on first placement, bumped by the executor on
+        # each recovery re-placement; threaded into the collective group
+        # epoch so the re-formed gang's rendezvous keys are fresh
+        self.generation = generation
         self.workers: List = []
         for i in range(num_workers):
-            opts = dict(resources=dict(resources_per_worker), num_cpus=0)
+            # max_concurrency=4: liveness pings and health polls must
+            # interleave with the long-blocking next_result call
+            opts = dict(resources=dict(resources_per_worker), num_cpus=0,
+                        max_concurrency=4)
             if placement_group is not None:
                 opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                     placement_group, placement_group_bundle_index=i

@@ -52,6 +52,18 @@ from ray_tpu.util import tracing
 
 _KV_NS = b"collective"
 
+# sentinel suffix: presence of <keybase>:__abort__ tells every rank blocked
+# in a rendezvous wait that this generation of the group is dead
+_ABORT_SUFFIX = b":__abort__"
+
+
+class CollectiveWorldChangedError(RuntimeError):
+    """The group's membership changed (a rank died or the gang was re-formed)
+    while this rank was inside a collective. In-flight rendezvous waits raise
+    this instead of running out the full collective timeout, so supervisors
+    can tear down and re-form the group in seconds.
+    """
+
 
 class ReduceOp:
     SUM = "sum"
@@ -76,6 +88,10 @@ class _Group:
     world_size: int
     rank: int
     backend: str
+    # generation epoch: bumped each time a gang re-forms a group under the
+    # same name (after a rank death). Threaded into every rendezvous key so
+    # a new generation cannot mis-join stale KV state from the dead one.
+    epoch: int = 0
     seq: int = 0  # per-group monotonic op counter (the steptrace join key)
     # sticky: the xla transport proved unavailable (CPU multiprocess);
     # ops route through the _phase ring path from then on
@@ -97,6 +113,22 @@ class _Group:
         seq = self.seq
         self.seq = (self.seq + 1) % steptrace.SEQ_MOD
         return seq
+
+    @property
+    def keybase(self) -> str:
+        """Rendezvous key prefix: generation-qualified group name."""
+        return _keybase(self.name, self.epoch)
+
+    @property
+    def trace_name(self) -> str:
+        """Group name as it appears in steptrace (group, seq) records.
+        Epoch 0 keeps the bare name so existing timelines/joins are
+        unchanged; re-formed generations are visibly distinct."""
+        return self.name if self.epoch == 0 else f"{self.name}@{self.epoch}"
+
+
+def _keybase(name: str, epoch: int) -> str:
+    return f"{name}@{epoch}"
 
 
 _groups: Dict[str, _Group] = {}
@@ -125,13 +157,25 @@ def _kv_del_prefix(prefix: bytes):
     cw.io.run(cw.gcs.request("kv_del", {"ns": _KV_NS, "key": prefix, "prefix": True}))
 
 
-def _kv_wait(key: bytes, timeout: float):
+def _kv_wait(key: bytes, timeout: float, abort_key: bytes | None = None):
+    """Poll ``key`` until it appears. When ``abort_key`` is given, every few
+    polls also check for the group's abort marker — a supervisor killing a
+    dead generation plants it so blocked survivors fail over in ~a poll
+    interval with a typed error instead of running out ``timeout``."""
     deadline = time.monotonic() + timeout
     delay = 0.002
+    polls = 0
     while time.monotonic() < deadline:
         v = _kv_get(key)
         if v is not None:
             return v
+        polls += 1
+        if abort_key is not None and polls % 5 == 0:
+            if _kv_get(abort_key) is not None:
+                raise CollectiveWorldChangedError(
+                    f"collective group aborted while waiting on {key!r}: "
+                    "membership changed (rank death or gang re-formation)"
+                )
         time.sleep(delay)
         delay = min(delay * 1.5, 0.05)
     raise TimeoutError(f"collective rendezvous timed out on {key!r}")
@@ -179,20 +223,24 @@ def init_collective_group(
     rank: int,
     backend: str = "xla",
     group_name: str = "default",
+    epoch: int = 0,
 ):
     """Declare this process's membership in a collective group
-    (ray parity: collective.py init_collective_group)."""
+    (ray parity: collective.py init_collective_group). ``epoch`` is the
+    gang generation: a re-formed group at the same name must pass the new
+    generation so its rendezvous keys cannot collide with the dead one's."""
     if world_size <= 0 or not (0 <= rank < world_size):
         raise ValueError(f"invalid world_size={world_size} rank={rank}")
     if backend not in ("xla", "store"):
         raise ValueError(f"unsupported backend {backend!r} (xla|store)")
     if backend == "xla":
         g = _build_xla_group(world_size, rank, group_name)
+        g.epoch = epoch
     else:
-        g = _Group(group_name, world_size, rank, backend)
+        g = _Group(group_name, world_size, rank, backend, epoch=epoch)
     with _lock:
         _groups[group_name] = g
-    _kv_put(f"{group_name}:member:{rank}".encode(), b"1")
+    _kv_put(f"{g.keybase}:member:{rank}".encode(), b"1")
 
 
 def create_collective_group(
@@ -201,17 +249,25 @@ def create_collective_group(
     ranks: List[int],
     backend: str = "xla",
     group_name: str = "default",
+    epoch: int = 0,
 ):
     """Declare a group over actor handles from the driver
     (ray parity: collective.py create_collective_group): each actor must call
     ``init_collective_group`` (we invoke it via a well-known method or
-    remote call on ``_rt_init_collective``)."""
+    remote call on ``_rt_init_collective``). ``epoch`` is only forwarded
+    when nonzero: the hook is a public parity surface and existing actors
+    define it without the parameter — only re-formed gangs (epoch > 0,
+    e.g. Train's recovery path, whose workers accept it) need the
+    generation threaded through."""
     import ray_tpu
 
     refs = []
     for actor, rank in zip(actors, ranks):
+        extra = (epoch,) if epoch else ()
         refs.append(
-            actor._rt_init_collective.remote(world_size, rank, backend, group_name)
+            actor._rt_init_collective.remote(
+                world_size, rank, backend, group_name, *extra
+            )
         )
     ray_tpu.get(refs, timeout=60)
 
@@ -223,7 +279,21 @@ def is_group_initialized(group_name: str = "default") -> bool:
 def destroy_collective_group(group_name: str = "default"):
     with _lock:
         _groups.pop(group_name, None)
+    # epoch-qualified keys ("name@<epoch>:...") plus the legacy bare prefix
+    _kv_del_prefix(f"{group_name}@".encode())
     _kv_del_prefix(f"{group_name}:".encode())
+
+
+def abort_group(group_name: str = "default", epoch: int | None = None):
+    """Plant the abort marker for a group generation. Every rank of that
+    generation blocked in a rendezvous wait raises
+    ``CollectiveWorldChangedError`` within a poll interval. Callable from
+    any connected process (the driver-side gang supervisor does NOT hold
+    the group locally, so it passes the generation explicitly)."""
+    if epoch is None:
+        g = _groups.get(group_name)
+        epoch = g.epoch if g else 0
+    _kv_put(_keybase(group_name, epoch).encode() + _ABORT_SUFFIX, b"1")
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -272,14 +342,16 @@ def _phase(g: _Group, op: str, timeout: float, payload: bytes,
     """
     if seq is None:
         seq = g.alloc_seq()
-    base = f"{g.name}:{seq}:{op}".encode()
+    base = f"{g.keybase}:{seq}:{op}".encode()
+    abort_key = g.keybase.encode() + _ABORT_SUFFIX
     _kv_put(base + f":{g.rank}".encode(), payload)
     outs = []
     for r in range(g.world_size):
-        outs.append(_kv_wait(base + f":{r}".encode(), timeout))
+        outs.append(_kv_wait(base + f":{r}".encode(), timeout,
+                             abort_key=abort_key))
     # rank 0 garbage-collects the previous phase's keys
     if g.rank == 0 and seq > 0:
-        _kv_del_prefix(f"{g.name}:{seq - 1}:".encode())
+        _kv_del_prefix(f"{g.keybase}:{seq - 1}:".encode())
     return outs
 
 
@@ -298,14 +370,14 @@ def _op(g: _Group, op: str, nbytes: int, call):
     start = time.time()
     try:
         if tracing.is_enabled():
-            with tracing.span(f"collective.{op}", group=g.name, seq=seq,
-                              rank=g.rank, world=g.world_size,
+            with tracing.span(f"collective.{op}", group=g.trace_name,
+                              seq=seq, rank=g.rank, world=g.world_size,
                               bytes=nbytes):
                 return call(seq)
         return call(seq)
     finally:
-        steptrace.record_collective(g.name, seq, op, g.rank, g.world_size,
-                                    start, time.time(), nbytes)
+        steptrace.record_collective(g.trace_name, seq, op, g.rank,
+                                    g.world_size, start, time.time(), nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -607,7 +679,7 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _group(group_name)
     seq = g.p2p_send.get(dst_rank, 0)
     g.p2p_send[dst_rank] = seq + 1
-    key = f"{g.name}:p2p:{seq}:{g.rank}->{dst_rank}".encode()
+    key = f"{g.keybase}:p2p:{seq}:{g.rank}->{dst_rank}".encode()
     _kv_put(key, pickle.dumps(_to_numpy(tensor), protocol=5))
 
 
@@ -616,8 +688,10 @@ def recv(tensor, src_rank: int, group_name: str = "default",
     g = _group(group_name)
     seq = g.p2p_recv.get(src_rank, 0)
     g.p2p_recv[src_rank] = seq + 1
-    key = f"{g.name}:p2p:{seq}:{src_rank}->{g.rank}".encode()
-    data = pickle.loads(_kv_wait(key, timeout))
+    key = f"{g.keybase}:p2p:{seq}:{src_rank}->{g.rank}".encode()
+    data = pickle.loads(
+        _kv_wait(key, timeout, abort_key=g.keybase.encode() + _ABORT_SUFFIX)
+    )
     if isinstance(tensor, np.ndarray):
         np.copyto(tensor, data.astype(tensor.dtype, copy=False))
         return tensor

@@ -1,0 +1,120 @@
+"""Chaos lane: elastic-training recovery under a seeded faultsim kill.
+
+Boots a local cluster, runs a short 2-worker trainer whose gang is armed
+with a ``RAY_TPU_RPC_FAULTS_FILE`` kill rule — the file env var is scoped
+to the train workers via the backend's ``env_vars`` runtime env, so the
+SIGKILL lands on a rank (the process replying to ``execute_task``
+frames), never on the driver or a raylet. The rule is armed mid-run
+(after a sentinel shows training is past step 2) and healed the moment
+the executor detects the failure, so the re-placed generation comes up
+clean.
+
+Gate: ``fit()`` completes from the restored checkpoint AND exactly one
+recovery was funded (``train_restarts_total == 1``). Exit 0/1.
+
+Replay: the armed rule is seeded (``execute_task:kill:1:7``) — re-running
+this script replays the same kill decision sequence.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KILL_RULE = "execute_task:kill:1:7\n"
+NUM_STEPS = 8
+
+
+def _loop(config):
+    import os
+    import time
+
+    from ray_tpu import train
+    from ray_tpu.air import Checkpoint
+
+    start = 0
+    ck = train.get_checkpoint()
+    if ck is not None:
+        start = ck.to_dict()["step"] + 1
+    for step in range(start, NUM_STEPS):
+        time.sleep(0.3)
+        if step == 2 and train.get_context().get_world_rank() == 0:
+            open(config["sentinel"], "w").close()
+        train.report({"step": step},
+                     checkpoint=Checkpoint.from_dict({"step": step}))
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train.backend_executor import _ft_metrics
+
+    tmp = tempfile.mkdtemp(prefix="chaos_train_recovery_")
+    rules = os.path.join(tmp, "faults.rules")
+    sentinel = os.path.join(tmp, "training_underway")
+    open(rules, "w").close()  # present-but-empty until armed
+
+    failures, restarts, recovery_hist = _ft_metrics()
+
+    def _gang_failures() -> float:
+        return sum(failures.labels(cause=c).value()
+                   for c in ("actor_died", "unresponsive", "wedged"))
+
+    def _arm_then_heal():
+        while not os.path.exists(sentinel):
+            time.sleep(0.05)
+        f0 = _gang_failures()
+        with open(rules, "w") as f:
+            f.write(KILL_RULE)
+        print(f"[chaos] armed kill rule: {KILL_RULE.strip()!r}", flush=True)
+        # heal the instant the executor detects the kill, so the
+        # re-placed generation's workers read an empty plan at spawn
+        while _gang_failures() <= f0:
+            time.sleep(0.05)
+        open(rules, "w").close()
+        print("[chaos] failure detected; rule healed", flush=True)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        watcher = threading.Thread(target=_arm_then_heal, daemon=True)
+        watcher.start()
+        trainer = train.JaxTrainer(
+            _loop,
+            train_loop_config={"sentinel": sentinel},
+            jax_config=train.JaxConfig(
+                distributed="off",
+                env_vars={
+                    "RAY_TPU_RPC_FAULTS_FILE": rules,
+                    "JAX_PLATFORMS": "cpu",
+                },
+            ),
+            scaling_config=train.ScalingConfig(num_workers=2),
+            run_config=train.RunConfig(
+                name="chaos_train_recovery", storage_path=tmp,
+                failure_config=train.FailureConfig(max_failures=1)),
+        )
+        result = trainer.fit()
+    finally:
+        ray_tpu.shutdown()
+
+    n_restarts = restarts.default.value()
+    rec = recovery_hist.default._series()
+    print(f"[chaos] error={result.error!r} "
+          f"final_step={(result.metrics or {}).get('step')} "
+          f"gang_failures={_gang_failures()} restarts={n_restarts} "
+          f"recovery_samples={rec['count']} recovery_sum_s={rec['sum']:.2f}",
+          flush=True)
+
+    ok = (result.error is None
+          and (result.metrics or {}).get("step") == NUM_STEPS - 1
+          and n_restarts == 1)
+    print(f"[chaos] train-recovery lane: {'PASS' if ok else 'FAIL'}",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,22 @@ timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m chaos -p no:cacheprovider \
     -p no:xdist -p no:randomly "$@"
 rc=$?
+
+# Train-recovery lane: a short 2-worker run whose gang is armed with a
+# seeded faultsim kill rule (RAY_TPU_RPC_FAULTS_FILE, scoped to the train
+# workers via the backend env_vars, armed mid-run then healed at
+# detection). Gate: fit() completes from the restored checkpoint and
+# train_restarts_total == 1. Skipped when pytest was given a -k subset.
+if [ "$#" -eq 0 ]; then
+    echo "--- train-recovery lane (seeded kill rule vs live gang) ---" >&2
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/chaos_train_recovery.py >&2
+    trc=$?
+    if [ "$trc" -ne 0 ] && [ "$rc" -eq 0 ]; then
+        rc=$trc
+    fi
+fi
+
 if [ "$rc" -ne 0 ]; then
     # Failure triage: dump a cluster-wide metrics snapshot from whatever
     # cluster is still reachable (a long-lived `ray_tpu start` cluster, or
@@ -37,6 +53,13 @@ if [ "$rc" -ne 0 ]; then
         python -m ray_tpu metrics -o "$out" >/dev/null 2>&1; then
         echo "cluster metrics snapshot -> $out" >&2
         grep -a 'rpc_faults_injected_total' "$out" >&2 || true
+        # elastic-training triage: gang failure causes, funded restarts,
+        # and the detection->ready recovery latency distribution — a lane
+        # failure with restarts but no completion points at the restore
+        # path; failures with no restarts point at detection
+        echo "--- train fault-tolerance counters (failures/restarts/recovery) ---" >&2
+        grep -aE 'train_worker_failures_total|train_restarts_total|train_recovery_seconds' \
+            "$out" >&2 || true
         # transfer-plane triage: dead/punched byte gauges make stuck
         # reclamation visible, and the slab-vs-file put counters show a
         # silent fall-off from the arena data path

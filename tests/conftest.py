@@ -45,6 +45,10 @@ def pytest_configure(config):
         "markers",
         "logs: log-plane test (attribution spans, streaming dedup, "
         "tail/range surfaces)")
+    config.addinivalue_line(
+        "markers",
+        "train_ft: elastic-training fault-tolerance test (watchdog, "
+        "epoch-keyed re-formation, checkpointed recovery, drain)")
 
 
 def wait_for_condition(condition, timeout: float = 30.0,

@@ -10,10 +10,24 @@ boundary. The resulting loss trajectory must match a single-process
 full-batch run bit-for-tolerance.
 """
 
+import jax
 import numpy as np
 import pytest
 
 import ray_tpu
+
+# The 2-process control plane itself works here (jax.distributed forms, both
+# workers join the coordinator), but jaxlib < 0.5 cannot EXECUTE a program
+# spanning processes on the CPU backend: XlaRuntimeError "Multiprocess
+# computations aren't implemented on the CPU backend". Cross-process CPU
+# collectives landed in jax 0.5 — gate, don't fake.
+_JAX_VERSION = tuple(int(x) for x in jax.__version__.split(".")[:2])
+pytestmark = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="jaxlib CPU backend predates cross-process execution "
+    "('Multiprocess computations aren't implemented on the CPU backend'); "
+    "needs jax>=0.5",
+)
 
 
 def _dp_train_loop(config):
@@ -26,6 +40,14 @@ def _dp_train_loop(config):
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion location, and its
+        # replication checker cannot prove AD-derived psum'd grads are
+        # replicated -- disable it (values are equal across shards)
+        from jax.experimental.shard_map import shard_map as _shard_map
+        shard_map = functools.partial(_shard_map, check_rep=False)
 
     from ray_tpu import train
 
@@ -56,7 +78,7 @@ def _dp_train_loop(config):
     )
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()),
     )
     def step(w, Xs, ys):
@@ -123,6 +145,14 @@ def _hybrid_train_loop(config):
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion location, and its
+        # replication checker cannot prove AD-derived psum'd grads are
+        # replicated -- disable it (values are equal across shards)
+        from jax.experimental.shard_map import shard_map as _shard_map
+        shard_map = functools.partial(_shard_map, check_rep=False)
+
     from ray_tpu import parallel, train
 
     ctx = train.get_context()
@@ -156,7 +186,7 @@ def _hybrid_train_loop(config):
     )
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), batch_spec, batch_spec), out_specs=(P(), P()),
     )
     def step(w, Xs, ys):
