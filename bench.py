@@ -784,6 +784,43 @@ def _control_plane_main():
     os._exit(0)
 
 
+def _collective_main():
+    """BENCH_COLLECTIVE=1: the collective-backend acceptance lane — store
+    allreduce at 64KB/1MB/64MB x {fp32, int8} x world {2, 4} with
+    p50/p95/p99, the chunked-vs-monolithic A/B at the top size, the int8
+    wire-compression ratio + analytic error-bound check, and the
+    skewed-rank sub-lane (one rank's kv_put stream stalled via faultsim)
+    gating straggler-aware chunk ordering against FIFO. Reported value is
+    the chunked/monolithic best-of-N speedup at the top size, world 2 —
+    the tentpole number. Gates: chunked never slower than monolithic,
+    int8 logical/wire >= 2x with error inside the per-block bound, and
+    straggler-aware p50 < FIFO p50 under injected skew. BENCH_SMALL
+    drops the 64MB size. Emits ONE JSON line, same contract as the
+    default bench path."""
+    import ray_tpu
+    from ray_tpu._private.perf import run_collective_bench
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    ray_tpu.init(num_cpus=4)
+    try:
+        rows = run_collective_bench(small=small)
+    finally:
+        ray_tpu.shutdown()
+    gate_row = next((r for r in rows
+                     if r["benchmark"] == "collective gates"), {})
+    speed = next((r for r in rows
+                  if r["benchmark"].startswith("chunked speedup")
+                  and r["benchmark"].endswith("w2")), {})
+    print(json.dumps({
+        "metric": "collective_chunked_speedup_top_size_w2",
+        "value": speed.get("value", 0.0),
+        "unit": "x (best-of-N vs monolithic)",
+        "vs_baseline": gate_row.get("value", 0.0),
+        "detail": rows,
+    }), flush=True)
+    os._exit(0)
+
+
 def _schedsim_main():
     """BENCH_SCHEDSIM=1: the gang-scheduler acceptance lane — schedsim
     (deterministic discrete-event simulator over the REAL placement-
@@ -861,6 +898,8 @@ def main():
         _control_plane_main()
     if os.environ.get("BENCH_SCHEDSIM"):
         _schedsim_main()
+    if os.environ.get("BENCH_COLLECTIVE"):
+        _collective_main()
 
     on_tpu = _tpu_reachable()
 

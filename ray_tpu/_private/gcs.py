@@ -596,8 +596,14 @@ class GcsServer:
         persisted = True
         if p.get("overwrite", True) or not existed:
             ns[p["key"]] = p["value"]
-            persisted = await self._persist_kv_awaited(
-                (nsname, p["key"]), p["value"])
+            # volatile: rendezvous-lifetime data (collective chunk
+            # payloads) that is useless after a GCS restart — the gang
+            # re-forms its group and republishes (PR 17 recovery path).
+            # Skipping the store write keeps multi-MB chunk streams off
+            # the disk path entirely.
+            if not p.get("volatile"):
+                persisted = await self._persist_kv_awaited(
+                    (nsname, p["key"]), p["value"])
         # persisted=False = the degraded no-persist posture: the write is
         # live in memory but would not survive a GCS kill -9 right now
         return {"added": not existed, "persisted": persisted}

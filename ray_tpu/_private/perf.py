@@ -512,3 +512,215 @@ def run_control_plane_bench(small: bool = False) -> List[dict]:
             print(f"{label:<24s} {0:>8d}        (no samples)")  # lint: allow-print
         rows.append(row)
     return rows
+
+
+# ---------------------------------------------------------------------------
+# Collective backend lane (BENCH_COLLECTIVE=1)
+# ---------------------------------------------------------------------------
+
+
+def run_collective_bench(small: bool = False) -> List[dict]:
+    """Collective-backend lane: store-path allreduce latency at
+    64KB / 1MB / 64MB x {fp32, int8} x world {2, 4} with p50/p95/p99,
+    the chunked-vs-monolithic A/B at the top size (the tentpole gate:
+    chunked must not lose, target >=1.3x), the int8 wire-compression
+    ratio (logical/wire bytes, target >=2x) with a driver-side check
+    that the quantized result stays inside the analytic per-block error
+    bound, and the skewed-rank sub-lane: one rank's kv_put RPCs are
+    slowed through the faultsim machinery and straggler-aware chunk
+    ordering (EWMA-reordered fetch schedule) is A/B'd against FIFO.
+    ``small`` drops the 64MB size and shrinks iteration counts (CI)."""
+    import ray_tpu
+    from ray_tpu._private.config import GLOBAL_CONFIG as cfg
+
+    sizes = [(64 << 10, "64KB", 30), (1 << 20, "1MB", 12),
+             (64 << 20, "64MB", 3)]
+    if small:
+        sizes = [(64 << 10, "64KB", 10), (1 << 20, "1MB", 5)]
+    worlds = [2, 4]
+    rows: List[dict] = []
+
+    @ray_tpu.remote
+    class ColWorker:
+        def _rt_init_collective(self, world_size, rank, backend, group_name,
+                                epoch=0, quant=""):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world_size, rank, backend, group_name,
+                                      epoch=epoch, quant=quant)
+            return rank
+
+        def set_cfg(self, updates):
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            GLOBAL_CONFIG.update(updates)
+            return True
+
+        def run_allreduce(self, group, nbytes, iters, seed, op="sum",
+                          return_out=False, nudge=False):
+            """Time ``iters`` allreduces of an nbytes fp32 tensor; returns
+            per-op durations plus this process's wire/logical byte and
+            chunk-retry deltas (from the collective transport counters).
+            ``nudge`` issues a throwaway kv_del before each op — the hook
+            the skew sub-lane's faultsim delay rule latches onto to stall
+            ONE rank's op entry (emulating compute skew)."""
+            from ray_tpu.util import collective as col
+            from ray_tpu.util.collective import collective as colmod
+
+            arr = np.random.RandomState(seed).randn(
+                max(1, nbytes // 4)).astype(np.float32)
+            m = colmod._metrics()
+            w0, l0 = m[0].default._value, m[1].default._value
+            r0 = m[2].default._value
+            durs, out = [], None
+            for _ in range(iters):
+                x = arr.copy()
+                if nudge:
+                    colmod._kv_del_prefix(b"__skew:nudge__")
+                t0 = time.perf_counter()
+                out = col.allreduce(x, group, op=op)
+                durs.append(time.perf_counter() - t0)
+            res = {"durs": durs, "wire": m[0].default._value - w0,
+                   "logical": m[1].default._value - l0,
+                   "retries": m[2].default._value - r0}
+            if return_out:
+                res["out"] = np.asarray(out)
+            return res
+
+    def _row(name, durs, extra=None):
+        d = np.array(durs) * 1e3
+        row = {"benchmark": name, "value": round(float(np.median(d)), 3),
+               "unit": "ms/op", "p50_ms": round(float(np.percentile(d, 50)), 3),
+               "p95_ms": round(float(np.percentile(d, 95)), 3),
+               "p99_ms": round(float(np.percentile(d, 99)), 3),
+               "iters": len(durs)}
+        if extra:
+            row.update(extra)
+        rows.append(row)
+        print(f"{name:<44s} p50={row['p50_ms']:>9,.2f}ms "  # lint: allow-print
+              f"p95={row['p95_ms']:>9,.2f}ms p99={row['p99_ms']:>9,.2f}ms"
+              + (f"  {extra}" if extra else ""))
+        return row
+
+    def _fanout(workers, group, nbytes, iters, op="sum", return_out=False,
+                nudge=False):
+        outs = ray_tpu.get(
+            [w.run_allreduce.remote(group, nbytes, iters, 1000 + r, op,
+                                    return_out and r == 0, nudge)
+             for r, w in enumerate(workers)], timeout=600)
+        return outs
+
+    gates: Dict[str, bool] = {}
+    from ray_tpu.util import collective as col
+
+    for world in worlds:
+        workers = [ColWorker.remote() for _ in range(world)]
+        for grp, quant in ((f"b{world}", ""), (f"q{world}", "int8")):
+            col.create_collective_group(workers, world, list(range(world)),
+                                        backend="store", group_name=grp,
+                                        quant=quant)
+        for nbytes, label, iters in sizes:
+            # fp32 chunked (default config: 1MB chunks, pipelined)
+            outs = _fanout(workers, f"b{world}", nbytes, iters)
+            _row(f"allreduce fp32 {label} w{world}", outs[0]["durs"])
+            # int8 quantized wire
+            outs = _fanout(workers, f"q{world}", nbytes, iters, op="sum",
+                           return_out=nbytes <= (1 << 20))
+            wire, logical = outs[0]["wire"], outs[0]["logical"]
+            ratio = logical / wire if wire else 0.0
+            _row(f"allreduce int8 {label} w{world}", outs[0]["durs"],
+                 {"wire_bytes": int(wire), "logical_bytes": int(logical),
+                  "logical_over_wire": round(ratio, 2)})
+            if nbytes == (1 << 20):
+                # acceptance: quantized wire bytes <= 0.3x logical
+                gates[f"int8_wire_w{world}"] = wire <= 0.3 * logical
+            if "out" in outs[0]:
+                # analytic per-block bound check against the true sum
+                arrs = [np.random.RandomState(1000 + r).randn(
+                    max(1, nbytes // 4)).astype(np.float32)
+                    for r in range(world)]
+                ref = np.sum(np.stack(arrs), axis=0)
+                err = float(np.abs(outs[0]["out"] - ref).max())
+                scales = [float(np.abs(a).max()) / 127.0 for a in arrs]
+                bound = 0.5 * sum(scales) + 0.5 * float(
+                    np.abs(ref).max()) / 127.0 + 1e-6
+                gates[f"int8_err_{label}_w{world}"] = err <= bound
+
+        # chunked-vs-monolithic A/B at the top size, fp32, best-of-N.
+        # Force a chunk size well below the tensor so the "chunked" arm
+        # actually chunks even in small mode (1MB tensors are NOT > the
+        # 1MB default threshold and would silently route monolithic).
+        nbytes, label, iters = sizes[-1]
+        ab_chunk = min(cfg.collective_chunk_bytes or (1 << 20),
+                       max(nbytes // 8, 64 << 10))
+        ray_tpu.get([w.set_cfg.remote({"collective_chunk_bytes": 0})
+                     for w in workers], timeout=30)
+        mono = _fanout(workers, f"b{world}", nbytes, iters)
+        _row(f"allreduce fp32 {label} w{world} monolithic", mono[0]["durs"])
+        ray_tpu.get([w.set_cfg.remote({"collective_chunk_bytes": ab_chunk})
+                     for w in workers], timeout=30)
+        chunked = _fanout(workers, f"b{world}", nbytes, iters)
+        _row(f"allreduce fp32 {label} w{world} chunked", chunked[0]["durs"])
+        ray_tpu.get([w.set_cfg.remote(
+            {"collective_chunk_bytes": cfg.collective_chunk_bytes})
+            for w in workers], timeout=30)
+        speedup = (min(mono[0]["durs"]) / min(chunked[0]["durs"])
+                   if chunked[0]["durs"] else 0.0)
+        rows.append({"benchmark": f"chunked speedup {label} w{world}",
+                     "value": round(speedup, 2), "unit": "x (best-of-N)",
+                     "chunk_bytes": ab_chunk,
+                     "mono_best_ms": round(min(mono[0]["durs"]) * 1e3, 2),
+                     "chunked_best_ms":
+                         round(min(chunked[0]["durs"]) * 1e3, 2)})
+        print(f"chunked speedup {label} w{world}: "  # lint: allow-print
+              f"{speedup:.2f}x (mono best {min(mono[0]['durs'])*1e3:.1f}ms "
+              f"-> chunked best {min(chunked[0]['durs'])*1e3:.1f}ms)")
+        if nbytes >= (64 << 20):
+            # the acceptance gates apply at the 64MB top size; small mode
+            # stops at 1MB, where chunk overhead ~ pipelining win (noise)
+            gates[f"chunked_not_slower_w{world}"] = speedup >= 1.0
+            if world == 2:
+                gates["chunked_speedup_target"] = speedup >= 1.3
+
+    # -- skewed-rank sub-lane: rank 1 enters every op late (a faultsim
+    # delay rule stalls its pre-op nudge RPC's write stream, emulating
+    # compute skew); straggler-aware chunk deferral vs FIFO, measured on
+    # fast rank 0. FIFO wedges the bounded window on the late rank's
+    # unpublished chunks, so fast-peer work serializes AFTER the skew;
+    # deferral does all of it UNDER the skew.
+    slow_env = {"runtime_env": {"env_vars": {
+        "RAY_TPU_RPC_FAULTS": "kv_del:delay:1:0:350"}}}
+    skew_workers = [ColWorker.remote(),
+                    ColWorker.options(**slow_env).remote(),
+                    ColWorker.remote()]
+    col.create_collective_group(skew_workers, 3, [0, 1, 2],
+                                backend="store", group_name="skew")
+    sk_bytes = (2 << 20) if small else (4 << 20)
+    sk_iters = 4 if small else 6
+    sk_cfg = {"collective_chunk_bytes": 64 << 10,
+              "collective_pipeline_depth": 2}
+    ray_tpu.get([w.set_cfg.remote(dict(sk_cfg,
+                                       collective_straggler_threshold=0.0))
+                 for w in skew_workers], timeout=30)
+    _fanout(skew_workers, "skew", sk_bytes, 2, nudge=True)  # warmup
+    fifo = _fanout(skew_workers, "skew", sk_bytes, sk_iters, nudge=True)
+    frow = _row("allreduce skew w3 fifo", fifo[0]["durs"],
+                {"retries": int(fifo[0]["retries"])})
+    ray_tpu.get([w.set_cfg.remote(dict(sk_cfg,
+                                       collective_straggler_threshold=0.05))
+                 for w in skew_workers], timeout=30)
+    _fanout(skew_workers, "skew", sk_bytes, 2, nudge=True)  # learn EWMA
+    strag = _fanout(skew_workers, "skew", sk_bytes, sk_iters, nudge=True)
+    srow = _row("allreduce skew w3 straggler-aware", strag[0]["durs"],
+                {"retries": int(strag[0]["retries"])})
+    gates["straggler_beats_fifo"] = srow["p50_ms"] < frow["p50_ms"]
+    rows.append({"benchmark": "straggler vs fifo p50",
+                 "value": round(frow["p50_ms"] / srow["p50_ms"], 3)
+                 if srow["p50_ms"] else 0.0,
+                 "unit": "x (>1 = straggler-aware wins)"})
+
+    rows.append({"benchmark": "collective gates",
+                 "value": float(all(gates.values())), "unit": "all-pass",
+                 "gates": gates})
+    print(f"gates: {gates}")  # lint: allow-print
+    return rows

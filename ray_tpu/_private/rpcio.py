@@ -1310,6 +1310,18 @@ class EventLoopThread:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
+    def submit(self, coro) -> "concurrent.futures.Future":
+        """Schedule a coroutine on the loop, returning its
+        ``concurrent.futures.Future`` for the caller to consume later —
+        the pipelined middle ground between ``run`` (block now) and
+        ``call_soon`` (never look). The chunked-collective transport
+        keeps a window of these in flight so reduction of one chunk
+        overlaps the RPC round trips of the next."""
+        if not self.loop.is_running():
+            coro.close()
+            raise RuntimeError("event loop is stopped")
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
     def call_soon(self, coro):
         if not self.loop.is_running():
             # Shutdown race: close the coroutine (avoids the un-awaited

@@ -162,7 +162,37 @@ def _ring_slot():
 
 
 def record_collective(group: str, seq: int, op: str, rank: int, world: int,
-                      start: float, end: float, nbytes: int):
+                      start: float, end: float, nbytes: int,
+                      wire: Optional[int] = None,
+                      logical: Optional[int] = None):
+    """``nbytes`` is the op's tensor payload size (unchanged series);
+    ``wire`` is what this rank actually moved over the transport after
+    chunk/quant encoding, and ``logical`` what the same movements would
+    have cost at full precision (both default to ``nbytes`` — the
+    monolithic fp32 path moves what it means). logical/wire is the
+    collective backend's effective-bandwidth series (EQuARX-style int8
+    quantization shows up here as ~4x)."""
+    global _events, _idx
+    if not _enabled:
+        return
+    ring = _ring_slot()
+    if ring is None:
+        return
+    _events += 1
+    wire = nbytes if wire is None else wire
+    ring[_idx % _ring_size] = (
+        "coll", _idx, group, seq % SEQ_MOD, op, rank, world, start, end,
+        nbytes, wire, wire if logical is None else logical)
+    _idx += 1
+
+
+def record_chunk(group: str, seq: int, chunk: int, op: str, rank: int,
+                 start: float, end: float, nbytes: int):
+    """One chunk of a chunked collective (transport+reduce interval for
+    sub-chunk ``chunk`` of the op at (group, seq)). Chunk records render
+    as their own timeline lane so overlap with compute phases is visible;
+    the (group, seq) skew join deliberately ignores them — the op is
+    still ONE collective row, delimited by its ``record_collective``."""
     global _events, _idx
     if not _enabled:
         return
@@ -171,7 +201,7 @@ def record_collective(group: str, seq: int, op: str, rank: int, world: int,
         return
     _events += 1
     ring[_idx % _ring_size] = (
-        "coll", _idx, group, seq % SEQ_MOD, op, rank, world, start, end,
+        "chunk", _idx, group, seq % SEQ_MOD, chunk, op, rank, start, end,
         nbytes)
     _idx += 1
 
@@ -374,6 +404,13 @@ def snapshot() -> List[dict]:
             out.append({"kind": "coll", "idx": rec[1], "group": rec[2],
                         "seq": rec[3], "op": rec[4], "rank": rec[5],
                         "world": rec[6], "start": rec[7], "end": rec[8],
+                        "bytes": rec[9],
+                        "wire": rec[10] if len(rec) > 10 else rec[9],
+                        "logical": rec[11] if len(rec) > 11 else rec[9]})
+        elif kind == "chunk":
+            out.append({"kind": "chunk", "idx": rec[1], "group": rec[2],
+                        "seq": rec[3], "chunk": rec[4], "op": rec[5],
+                        "rank": rec[6], "start": rec[7], "end": rec[8],
                         "bytes": rec[9]})
         elif kind == "phase":
             out.append({"kind": "phase", "idx": rec[1], "step": rec[2],
@@ -464,6 +501,8 @@ def merge_collectives(records: Sequence[dict],
                 row["ranks"][rec["rank"]] = {
                     "start": rec["start"], "end": rec["end"],
                     "bytes": rec.get("bytes", 0),
+                    "wire": rec.get("wire", rec.get("bytes", 0)),
+                    "logical": rec.get("logical", rec.get("bytes", 0)),
                 }
             starts = {r: v["start"] for r, v in row["ranks"].items()}
             first_rank = min(starts, key=starts.get)
@@ -487,6 +526,7 @@ def merge_records(records: Sequence[dict]) -> Dict[str, Any]:
     steps: List[dict] = []
     compiles: List[dict] = []
     restarts: List[dict] = []
+    chunks: List[dict] = []
     for rec in records:
         kind = rec.get("kind")
         if kind == "coll":
@@ -499,16 +539,20 @@ def merge_records(records: Sequence[dict]) -> Dict[str, Any]:
             compiles.append(rec)
         elif kind == "restart":
             restarts.append(rec)
+        elif kind == "chunk":
+            chunks.append(rec)
     phases.sort(key=lambda r: r["start"])
     steps.sort(key=lambda r: r["start"])
     compiles.sort(key=lambda r: r["start"])
     restarts.sort(key=lambda r: r["start"])
+    chunks.sort(key=lambda r: r["start"])
     return {
         "collectives": merge_collectives(colls),
         "phases": phases,
         "steps": steps,
         "compiles": compiles,
         "restarts": restarts,
+        "chunks": chunks,
     }
 
 
@@ -570,12 +614,23 @@ def chrome_trace(merged: Dict[str, Any]) -> List[dict]:
                 "args": {
                     "group": row["group"], "seq": row["seq"],
                     "op": row["op"], "bytes": v.get("bytes", 0),
+                    "wire": v.get("wire", v.get("bytes", 0)),
                     "skew_s": row["skew"],
                     "last_rank": row["last_rank"],
                     "arrived_last": rank == row["last_rank"],
                     "missing": row["missing"],
                 },
             })
+    for rec in merged.get("chunks", ()):
+        proc_meta(rec["rank"])
+        trace.append({
+            "name": f"{rec['op']}#{rec['seq']}.{rec['chunk']}",
+            "cat": "chunk", "ph": "X", "ts": rec["start"] * 1e6,
+            "dur": max((rec["end"] - rec["start"]) * 1e6, 1.0),
+            "pid": rec["rank"], "tid": f"chunks:{rec['group']}",
+            "args": {"group": rec["group"], "seq": rec["seq"],
+                     "chunk": rec["chunk"], "bytes": rec.get("bytes", 0)},
+        })
     for rec in merged.get("compiles", ()):
         proc_meta(rec["rank"])
         trace.append({

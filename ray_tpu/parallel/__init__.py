@@ -1,9 +1,11 @@
 from ray_tpu.parallel.collectives import (
     all_gather,
+    chunked_psum,
     compiled_allreduce,
     pmean,
     ppermute_next,
     psum,
+    quantized_psum,
     reduce_scatter,
 )
 from ray_tpu.parallel.mesh_utils import (
@@ -20,7 +22,9 @@ from ray_tpu.parallel.mesh_utils import (
 __all__ = [
     "all_gather",
     "auto_mesh",
+    "chunked_psum",
     "compiled_allreduce",
+    "quantized_psum",
     "create_hybrid_mesh",
     "create_mesh",
     "data_sharding",

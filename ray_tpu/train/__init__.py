@@ -11,8 +11,9 @@ from ray_tpu.train.backend import (Backend, BackendConfig, JaxConfig,
 from ray_tpu.train.backend_executor import (BackendExecutor,
                                             FailureBudgetExhaustedError,
                                             TrainingFailedError)
-from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report, step_phase)
+from ray_tpu.train.session import (GradSync, get_checkpoint, get_context,
+                                   get_dataset_shard, report,
+                                   set_overlap_grads, step_phase)
 from ray_tpu.train.trainer import (
     BaseTrainer,
     DataParallelTrainer,
@@ -54,10 +55,12 @@ __all__ = [
     "SklearnTrainer",
     "TrainingFailedError",
     "WorkerGroup",
+    "GradSync",
     "get_checkpoint",
     "get_dataset_shard",
     "get_context",
     "report",
+    "set_overlap_grads",
     "step_phase",
     "TransformersTrainer",
 ]

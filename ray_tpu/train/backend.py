@@ -48,11 +48,19 @@ class JaxConfig(BackendConfig):
     TPU gangs (multi-host pods); "off" leaves workers as independent JAX
     processes whose host-level sync goes through ray_tpu.util.collective;
     "force" always initializes.
+
+    overlap_grads arms ``session.GradSync`` overlap on every worker:
+    gradient allreduces dispatch on a background thread so their chunked
+    collective spans interleave with the step's compute phase spans.
+    collective_quant ("int8") makes the train_dp group's SUM/MEAN
+    allreduces ride the block-quantized wire format.
     """
 
     distributed: str = "auto"
     use_tpu: bool = False
     env_vars: Dict[str, str] = field(default_factory=dict)
+    overlap_grads: bool = False
+    collective_quant: str = ""
 
     @property
     def backend_cls(self):
@@ -71,6 +79,13 @@ def _jax_worker_setup(coordinator: Optional[str], num_processes: int,
             num_processes=num_processes,
             process_id=process_id,
         )
+    return True
+
+
+def _enable_overlap():
+    from ray_tpu.train import session
+
+    session.set_overlap_grads(True)
     return True
 
 
@@ -105,6 +120,11 @@ class _JaxBackend(Backend):
                 )
             )
         ray_tpu.get(refs, timeout=300)
+        if config.overlap_grads:
+            ray_tpu.get(
+                [w.execute.remote(_enable_overlap) for w in worker_group.workers],
+                timeout=300,
+            )
         # Host-level collective group for out-of-graph sync (weight
         # broadcast, metric reduction) — the Gloo-analog path.
         if n > 1:
@@ -116,6 +136,7 @@ class _JaxBackend(Backend):
                 worker_group.workers, n, list(range(n)),
                 backend="store", group_name="train_dp",
                 epoch=getattr(worker_group, "generation", 0),
+                quant=config.collective_quant,
             )
 
 

@@ -80,16 +80,25 @@ class _Session:
         self.drain_requested = threading.Event()
         self.step_count = 0
         self.last_progress = time.monotonic()
+        # JaxTrainer(overlap_grads=True): GradSync dispatches gradient
+        # allreduces on a background thread so collective chunk spans
+        # interleave with the step's compute phase spans
+        self.overlap_grads = False
 
 
 _session: Optional[_Session] = None
 _lock = threading.Lock()
+# process default for overlap_grads: the backend's on_start runs before the
+# worker enters its train loop (and so before init_session), so the trainer
+# flag lands here and every subsequent session inherits it
+_overlap_default = False
 
 
 def init_session(ctx: TrainContext, loaded_checkpoint: Optional[Checkpoint]) -> _Session:
     global _session
     with _lock:
         _session = _Session(ctx, loaded_checkpoint)
+        _session.overlap_grads = _overlap_default
     # steptrace records (phases, step boundaries, compiles) carry this
     # worker's rank from here on; step 0 starts now. The jax.monitoring
     # listener mirrors backend compile events into the ring so compile
@@ -184,6 +193,95 @@ def step_phase(name: str):
         train.report({"loss": float(loss)})   # step boundary
     """
     return steptrace.phase(name)
+
+
+def set_overlap_grads(enabled: bool) -> bool:
+    """Arm (or disarm) gradient/compute overlap — the trainer's
+    ``overlap_grads=True`` lands here on each worker (at backend
+    ``on_start``, i.e. usually before the session exists, hence the
+    sticky process default). Returns whether a live session took it."""
+    global _overlap_default
+    _overlap_default = bool(enabled)
+    s = _session
+    if s is None:
+        return False
+    s.overlap_grads = bool(enabled)
+    return True
+
+
+class GradSync:
+    """Per-tensor gradient allreduce with optional compute overlap.
+
+    ``submit(name, grad)`` hands one gradient tensor to the collective
+    backend; ``results()`` waits for everything submitted and returns
+    ``{name: reduced}`` in submission order. With overlap on (the
+    session's ``overlap_grads`` flag, or ``overlap=True`` explicitly),
+    submits dispatch on ONE background thread so the store-path chunked
+    allreduce runs under the remaining backward/step compute — its
+    collective + chunk spans interleave with ``step_phase("compute")``
+    spans in the train timeline (T3-style, arxiv 2401.16677). With
+    overlap off, submit reduces inline (same results, serial timeline).
+
+    Ordering contract: all ranks must submit the same tensor names in
+    the same order (the usual DDP bucket contract) — the single
+    dispatch thread preserves submission order, so the group's seq
+    numbers stay aligned across ranks. Don't run other collectives on
+    the same group concurrently with a live GradSync.
+    """
+
+    def __init__(self, group_name: str = "train_dp", op: str = "mean",
+                 overlap: Optional[bool] = None,
+                 timeout: Optional[float] = None):
+        s = _session
+        if overlap is None:
+            overlap = bool(s and s.overlap_grads)
+        self.group_name = group_name
+        self.op = op
+        self.overlap = overlap
+        self.timeout = timeout
+        self._pending: list = []  # (name, result | Future)
+        self._pool = None
+        if overlap:
+            import concurrent.futures
+
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="gradsync")
+
+    def _reduce(self, tensor):
+        from ray_tpu.util import collective as col
+
+        kwargs = {}
+        if self.timeout is not None:
+            kwargs["timeout"] = self.timeout
+        return col.allreduce(tensor, self.group_name, op=self.op, **kwargs)
+
+    def submit(self, name: str, tensor) -> None:
+        if self._pool is not None:
+            self._pending.append((name, self._pool.submit(self._reduce,
+                                                          tensor)))
+        else:
+            self._pending.append((name, self._reduce(tensor)))
+
+    def results(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        pending, self._pending = self._pending, []
+        for name, r in pending:
+            out[name] = r.result() if hasattr(r, "result") else r
+        return out
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.results()
+        self.close()
+        return False
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -163,9 +163,14 @@ class JaxTrainer(DataParallelTrainer):
     """
 
     def __init__(self, train_loop_per_worker, *, jax_config: Optional[JaxConfig] = None,
-                 scaling_config: Optional[ScalingConfig] = None, **kwargs):
+                 scaling_config: Optional[ScalingConfig] = None,
+                 overlap_grads: bool = False, **kwargs):
         scaling_config = scaling_config or ScalingConfig()
         jc = jax_config or JaxConfig(use_tpu=scaling_config.use_tpu)
+        if overlap_grads:
+            # arm session.GradSync overlap on every worker: gradient
+            # allreduces run chunk-pipelined under the step's compute
+            jc.overlap_grads = True
         super().__init__(
             train_loop_per_worker,
             backend_config=jc,

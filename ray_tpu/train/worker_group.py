@@ -42,11 +42,11 @@ class TrainWorker:
         return fn(*args, **kwargs)
 
     def _rt_init_collective(self, world_size, rank, backend, group_name,
-                            epoch=0):
+                            epoch=0, quant=""):
         from ray_tpu.util import collective as col
 
         col.init_collective_group(world_size, rank, backend, group_name,
-                                  epoch=epoch)
+                                  epoch=epoch, quant=quant)
         return rank
 
     def ping(self):

@@ -22,13 +22,48 @@ metric reduction); inner-loop gradient reduction should use the in-graph
 path (ray_tpu.parallel / trainers), exactly as NCCL-allreduce lives inside
 torch DDP in the reference.
 
+The store-path allreduce is not a naive payload swap: three composable,
+independently flag-gated levers rebuild the hot path (each A/B-able
+against the steptrace (group, seq) skew series PR 11 shipped):
+
+1. **Chunked pipeline transport** (``collective_chunk_bytes``, default
+   1MB; 0 = off): tensors above the threshold are reduce-scattered and
+   allgathered in fixed-size chunks — each rank OWNS 1/world of the
+   tensor, peers publish their contribution chunks, the owner
+   accumulates and republishes the reduced chunk as soon as its last
+   contribution lands, and a bounded in-flight window
+   (``collective_pipeline_depth``) keeps reduction of chunk N
+   overlapping the RPC round trips of chunk N+1. Chunk payloads ride
+   rpcio's v2 out-of-band buffer table (``BufferList``): tensor bytes
+   are never copied into a pickle envelope.
+2. **Block-wise int8 quantization** (EQuARX-style, arxiv 2506.17615):
+   ``quant="int8"`` per group (or ``RAY_TPU_collective_quant``) puts a
+   per-chunk symmetric scale + int8 payload on the wire for SUM/MEAN
+   float allreduces, dequantize-accumulate-requantize at the owner,
+   fp32 restore at the end. All ranks — including the owner — decode
+   the SAME requantized wire form, so results stay bit-identical
+   across ranks. Non-SUM/MEAN ops and non-float dtypes fall back to
+   exact full-precision transport.
+3. **Straggler-tolerant chunk scheduling** (arxiv 2505.23523): chunk
+   headers carry producer put-timestamps; each peer's arrival lag is
+   folded into an EWMA, and a peer whose lag exceeds
+   ``collective_straggler_threshold`` has its chunks fetched LAST so
+   the pipeline window stays busy on ranks that have already
+   published (0 = FIFO rank order).
+
 Telemetry: every op (allreduce/allgather/reducescatter/broadcast/barrier)
 consumes one per-group monotonic sequence number and records a steptrace
 event (rank-local start/end/bytes keyed by (group, seq) — see
 _private/steptrace.py) so a GCS-side merge can attribute per-collective
-arrival skew to the rank that showed up last. With RAY_TPU_TRACING=1 each
-op additionally emits a tracing span, interleaving with task spans in
-``state.timeline()``.
+arrival skew to the rank that showed up last. Op records carry
+``bytes`` (tensor size), ``wire`` (bytes this rank actually moved over
+the transport, post-encoding) and ``logical`` (what the same movements
+would have cost at full precision) — logical/wire is the
+effective-bandwidth series the quantized path is judged by. Chunked ops
+additionally record per-chunk spans (their own timeline lane; the
+(group, seq) skew join still sees ONE collective row per op). With
+RAY_TPU_TRACING=1 each op additionally emits a tracing span,
+interleaving with task spans in ``state.timeline()``.
 
 CPU portability: when the runtime cannot execute multiprocess XLA
 computations (CPU backend raises "Multiprocess computations aren't
@@ -39,15 +74,18 @@ records) works everywhere; only the transport differs.
 
 from __future__ import annotations
 
+import asyncio
 import pickle
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ray_tpu._private import steptrace
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.serialization import BufferList
 from ray_tpu.util import tracing
 
 _KV_NS = b"collective"
@@ -81,6 +119,130 @@ _REDUCERS = {
     ReduceOp.MEAN: lambda xs: np.mean(xs, axis=0),
 }
 
+# pairwise accumulation ufuncs for the chunked path (MEAN = add + divide)
+_ACC_UFUNC = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.MEAN: np.add,
+    ReduceOp.PRODUCT: np.multiply,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
+}
+
+_metrics_cached = None
+
+
+def _metrics():
+    """Collective transport counters on the process registry (they ride
+    the /metrics cluster scrape; run_chaos.sh triage greps them)."""
+    global _metrics_cached
+    if _metrics_cached is None:
+        from ray_tpu._private import metrics_core
+
+        reg = metrics_core.registry()
+        _metrics_cached = (
+            reg.counter("collective_wire_bytes_total",
+                        "bytes this process moved over the collective "
+                        "transport (post chunk/quant encoding)"),
+            reg.counter("collective_logical_bytes_total",
+                        "full-precision-equivalent bytes of the same "
+                        "collective transport movements"),
+            reg.counter("collective_chunk_retries_total",
+                        "extra rendezvous polls while waiting on "
+                        "collective chunks (peer not yet published)"),
+            reg.counter("collective_chunks_total",
+                        "chunks moved by the chunked collective path"),
+        )
+    return _metrics_cached
+
+
+# ---------------------------------------------------------------------------
+# wire codec: header + raw tensor bytes as out-of-band BufferList buffers
+# ---------------------------------------------------------------------------
+#
+# A tensor payload is BufferList([header, body]): the pickled header
+# (dtype/shape/quant-scale/producer-timestamp, ~150B, stays in the pickle
+# envelope) and the raw tensor bytes, which rpcio's v2 framing sends
+# out-of-band by reference — no pickle.dumps copy of the tensor on the
+# send side, and a zero-copy memoryview over the read buffer on the
+# receive side. Object-dtype tensors (and b"" markers) stay plain bytes.
+
+_QS_EPS = 0.0  # symmetric int8: scale = max|x| / 127, zero-safe below
+
+
+def _quant_encode(arr: np.ndarray):
+    """Symmetric per-block int8 quantization: returns (int8 array, scale).
+    The scale is computed in float64 and stored as a python float so
+    every rank dequantizes from the identical value."""
+    amax = float(np.max(np.abs(arr), initial=0.0))
+    scale = amax / 127.0
+    if scale <= 0.0:
+        return np.zeros(arr.shape, np.int8), 0.0
+    q = np.clip(np.rint(arr.astype(np.float32) / np.float32(scale)),
+                -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _quant_decode(q: np.ndarray, scale: float) -> np.ndarray:
+    """Dequantize — deterministic fp32 arithmetic, identical on every
+    rank that holds the same wire bytes."""
+    if scale <= 0.0:
+        return np.zeros(q.shape, np.float32)
+    return q.astype(np.float32) * np.float32(scale)
+
+
+def _wrap_body(hd_fields: dict, body_arr: np.ndarray) -> BufferList:
+    hd = pickle.dumps(dict(hd_fields, t=time.time()), protocol=5)
+    # 1-D view keeps the memoryview cast-safe for 0-d/N-d inputs alike
+    return BufferList([hd, memoryview(body_arr.reshape(-1)).cast("B")])
+
+
+def _enc_quant(q: np.ndarray, scale: float, dtype_str: str,
+               shape) -> BufferList:
+    """Wire form of an ALREADY-quantized block — the owner publishes the
+    exact int8+scale it will locally dequantize, which is what makes the
+    reduced result bit-identical across ranks."""
+    return _wrap_body({"d": dtype_str, "s": shape, "q": "int8",
+                       "sc": scale}, q)
+
+
+def _enc_tensor(arr: np.ndarray, quant: str = "") -> "BufferList | bytes":
+    """Encode a tensor (or chunk view) for the rendezvous wire."""
+    if arr.dtype == object:
+        return pickle.dumps(arr, protocol=5)  # structured payloads: legacy
+    shape = arr.shape  # before ascontiguousarray, which promotes 0-d to 1-d
+    arr = np.ascontiguousarray(arr)
+    if quant == "int8":
+        q, scale = _quant_encode(arr)
+        return _enc_quant(q, scale, str(arr.dtype), shape)
+    return _wrap_body({"d": str(arr.dtype), "s": shape, "q": "",
+                       "sc": None}, arr)
+
+
+def _dec_tensor(value) -> "tuple[np.ndarray, Optional[dict]]":
+    """Decode a wire payload -> (tensor, header). Quantized payloads come
+    back dequantized to fp32 (all ranks run the identical arithmetic on
+    the identical wire bytes). The returned array may be a read-only
+    view over the receive buffer — reducers copy, callers that need
+    ownership copy."""
+    if isinstance(value, BufferList):
+        bufs = value.buffers
+        hd0 = bufs[0]
+        hd = pickle.loads(hd0 if isinstance(hd0, bytes) else bytes(hd0))
+        body = bufs[1] if len(bufs) > 1 else b""
+        shape = hd["s"]
+        if hd["q"] == "int8":
+            q = np.frombuffer(body, dtype=np.int8).reshape(shape)
+            return _quant_decode(q, hd["sc"] or 0.0), hd
+        return np.frombuffer(body, dtype=np.dtype(hd["d"])).reshape(shape), hd
+    return pickle.loads(value), None
+
+
+def _vsize(value) -> int:
+    """Encoded size of a wire payload (what actually crossed the wire)."""
+    if isinstance(value, BufferList):
+        return value.nbytes
+    return len(value) if value is not None else 0
+
 
 @dataclass
 class _Group:
@@ -96,6 +258,14 @@ class _Group:
     # sticky: the xla transport proved unavailable (CPU multiprocess);
     # ops route through the _phase ring path from then on
     xla_fallback: bool = False
+    # "" (full precision) or "int8": block-wise quantized wire for
+    # SUM/MEAN float allreduces on the store path (group-level opt-in;
+    # the RAY_TPU_collective_quant flag is the process-wide default)
+    quant: str = ""
+    # rank -> EWMA arrival lag (s) behind the op's fastest publisher,
+    # learned from chunk-header put timestamps; drives straggler-last
+    # chunk fetch ordering
+    peer_lag: Dict[int, float] = field(default_factory=dict)
     p2p_send: Dict[int, int] = None  # per-destination send counters
     p2p_recv: Dict[int, int] = None  # per-source recv counters
     mesh: object = None  # xla backend: 1-device-per-rank Mesh over axis "ranks"
@@ -142,14 +312,59 @@ def _cw():
     return global_worker.core_worker
 
 
-def _kv_put(key: bytes, value: bytes):
+def _kv_put(key: bytes, value, volatile: bool = False):
+    """Put into the collective KV namespace. ``volatile=True`` marks
+    rendezvous-lifetime data (tensor payloads a re-formed gang would
+    republish anyway) that skips the GCS persist log; group membership,
+    abort markers, and anything a GCS restart must replay stay
+    persistent (the default)."""
     cw = _cw()
-    cw.io.run(cw.gcs.request("kv_put", {"ns": _KV_NS, "key": key, "value": value}))
+    cw.io.run(cw.gcs.request("kv_put", {"ns": _KV_NS, "key": key,
+                                        "value": value,
+                                        "volatile": volatile}))
 
 
 def _kv_get(key: bytes):
     cw = _cw()
     return cw.io.run(cw.gcs.request("kv_get", {"ns": _KV_NS, "key": key}))
+
+
+# async twins, scheduled on the core worker's io loop so the chunked
+# transport can keep a pipelined window of puts/waits in flight while
+# the calling thread reduces already-arrived chunks. The numpy work
+# stays OFF the io loop — these coroutines only do RPC round trips.
+
+async def _akv_put(cw, key: bytes, value):
+    await cw.gcs.request("kv_put", {"ns": _KV_NS, "key": key,
+                                    "value": value, "volatile": True})
+
+
+async def _akv_wait(cw, key: bytes, timeout: float,
+                    abort_key: Optional[bytes] = None):
+    """Async poll for ``key`` (chunk rendezvous): same backoff + abort
+    semantics as the sync ``_kv_wait``. Extra polls (the peer had not
+    published yet) feed the chunk-retry counter chaos triage greps."""
+    deadline = time.monotonic() + timeout
+    delay = 0.002
+    polls = 0
+    while time.monotonic() < deadline:
+        v = await cw.gcs.request("kv_get", {"ns": _KV_NS, "key": key})
+        if v is not None:
+            if polls:
+                _metrics()[2].inc(polls)
+            return v
+        polls += 1
+        if abort_key is not None and polls % 5 == 0:
+            a = await cw.gcs.request("kv_get", {"ns": _KV_NS,
+                                                "key": abort_key})
+            if a is not None:
+                raise CollectiveWorldChangedError(
+                    f"collective group aborted while waiting on {key!r}: "
+                    "membership changed (rank death or gang re-formation)"
+                )
+        await asyncio.sleep(delay)
+        delay = min(delay * 1.5, 0.05)
+    raise TimeoutError(f"collective rendezvous timed out on {key!r}")
 
 
 def _kv_del_prefix(prefix: bytes):
@@ -224,20 +439,27 @@ def init_collective_group(
     backend: str = "xla",
     group_name: str = "default",
     epoch: int = 0,
+    quant: str = "",
 ):
     """Declare this process's membership in a collective group
     (ray parity: collective.py init_collective_group). ``epoch`` is the
     gang generation: a re-formed group at the same name must pass the new
-    generation so its rendezvous keys cannot collide with the dead one's."""
+    generation so its rendezvous keys cannot collide with the dead one's.
+    ``quant="int8"`` opts this group's float SUM/MEAN allreduces into the
+    block-wise quantized wire (must be passed identically on every
+    rank)."""
     if world_size <= 0 or not (0 <= rank < world_size):
         raise ValueError(f"invalid world_size={world_size} rank={rank}")
     if backend not in ("xla", "store"):
         raise ValueError(f"unsupported backend {backend!r} (xla|store)")
+    if quant not in ("", "int8"):
+        raise ValueError(f"unsupported quant {quant!r} (''|'int8')")
     if backend == "xla":
         g = _build_xla_group(world_size, rank, group_name)
         g.epoch = epoch
     else:
         g = _Group(group_name, world_size, rank, backend, epoch=epoch)
+    g.quant = quant
     with _lock:
         _groups[group_name] = g
     _kv_put(f"{g.keybase}:member:{rank}".encode(), b"1")
@@ -250,20 +472,27 @@ def create_collective_group(
     backend: str = "xla",
     group_name: str = "default",
     epoch: int = 0,
+    quant: str = "",
 ):
     """Declare a group over actor handles from the driver
     (ray parity: collective.py create_collective_group): each actor must call
     ``init_collective_group`` (we invoke it via a well-known method or
-    remote call on ``_rt_init_collective``). ``epoch`` is only forwarded
-    when nonzero: the hook is a public parity surface and existing actors
-    define it without the parameter — only re-formed gangs (epoch > 0,
-    e.g. Train's recovery path, whose workers accept it) need the
-    generation threaded through."""
+    remote call on ``_rt_init_collective``). ``epoch``/``quant`` are only
+    forwarded when set: the hook is a public parity surface and existing
+    actors define it without the parameters — only re-formed gangs
+    (epoch > 0, e.g. Train's recovery path) or quant-opted groups, whose
+    workers accept them, need the extras threaded through."""
     import ray_tpu
 
+    if quant not in ("", "int8"):
+        raise ValueError(f"unsupported quant {quant!r} (''|'int8')")
     refs = []
     for actor, rank in zip(actors, ranks):
-        extra = (epoch,) if epoch else ()
+        extra = ()
+        if quant:
+            extra = (epoch, quant)
+        elif epoch:
+            extra = (epoch,)
         refs.append(
             actor._rt_init_collective.remote(
                 world_size, rank, backend, group_name, *extra
@@ -329,8 +558,8 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
-def _phase(g: _Group, op: str, timeout: float, payload: bytes,
-           seq: Optional[int] = None) -> List[bytes]:
+def _phase(g: _Group, op: str, timeout: float, payload,
+           seq: Optional[int] = None, tel: Optional[dict] = None) -> List:
     """All ranks contribute payload; returns all contributions rank-ordered.
 
     KV-barrier rendezvous keyed by (group, seq, op). The GCS KV plays the
@@ -338,17 +567,24 @@ def _phase(g: _Group, op: str, timeout: float, payload: bytes,
     collective_group/nccl_util.py store-based unique-id exchange).
     ``seq`` is the op's already-allocated group sequence number (every
     public op allocates one up front so steptrace records and rendezvous
-    keys agree); direct callers may omit it.
+    keys agree); direct callers may omit it. ``payload`` is bytes or an
+    encoded-tensor ``BufferList`` (the out-of-band form); ``tel``, when
+    given, accumulates wire/logical transport bytes.
     """
     if seq is None:
         seq = g.alloc_seq()
     base = f"{g.keybase}:{seq}:{op}".encode()
     abort_key = g.keybase.encode() + _ABORT_SUFFIX
-    _kv_put(base + f":{g.rank}".encode(), payload)
+    _kv_put(base + f":{g.rank}".encode(), payload, volatile=True)
     outs = []
     for r in range(g.world_size):
         outs.append(_kv_wait(base + f":{r}".encode(), timeout,
                              abort_key=abort_key))
+    if tel is not None:
+        # monolithic transport is full precision: wire == logical
+        moved = _vsize(payload) + sum(_vsize(o) for o in outs)
+        tel["wire"] += moved
+        tel["logical"] += moved
     # rank 0 garbage-collects the previous phase's keys
     if g.rank == 0 and seq > 0:
         _kv_del_prefix(f"{g.keybase}:{seq - 1}:".encode())
@@ -359,7 +595,11 @@ def _op(g: _Group, op: str, nbytes: int, call):
     """Run one collective op under telemetry: allocate the per-group seq,
     time the rank-local interval into the steptrace ring, and (with
     tracing enabled) wrap it in a span so it interleaves with task spans
-    in state.timeline(). ``call(seq)`` performs the actual transport.
+    in state.timeline(). ``call(seq, tel)`` performs the actual
+    transport, accumulating actual/full-precision transport bytes into
+    ``tel["wire"]``/``tel["logical"]`` (left 0 = transport didn't
+    measure, e.g. the in-graph XLA path; the record then defaults both
+    to ``nbytes``).
 
     The record lands in a ``finally``: a rank that RAISES (rendezvous
     timeout because a peer never arrived — the straggler failure this
@@ -367,17 +607,287 @@ def _op(g: _Group, op: str, nbytes: int, call):
     long it waited, so the GCS merge shows the (group, seq) row with the
     wedged rank in ``missing`` instead of showing nothing at all."""
     seq = g.alloc_seq()
+    tel = {"wire": 0, "logical": 0}
     start = time.time()
     try:
         if tracing.is_enabled():
             with tracing.span(f"collective.{op}", group=g.trace_name,
                               seq=seq, rank=g.rank, world=g.world_size,
                               bytes=nbytes):
-                return call(seq)
-        return call(seq)
+                return call(seq, tel)
+        return call(seq, tel)
     finally:
+        wire = tel["wire"] or None
+        logical = tel["logical"] or None
+        if wire is not None:
+            m = _metrics()
+            m[0].inc(wire)
+            m[1].inc(logical or wire)
         steptrace.record_collective(g.trace_name, seq, op, g.rank,
-                                    g.world_size, start, time.time(), nbytes)
+                                    g.world_size, start, time.time(),
+                                    nbytes, wire=wire, logical=logical)
+
+
+# ---------------------------------------------------------------------------
+# chunked pipeline transport (store path): reduce-scatter + allgather over
+# fixed-size chunks, pipelined on the core worker's io loop
+# ---------------------------------------------------------------------------
+
+
+def _chunk_layout(n: int, world: int, chunk_elems: int) -> List[List[tuple]]:
+    """Owner-sharded chunk plan over a flat n-element tensor: shard o
+    (owned by rank o) is elements [o*n//world, (o+1)*n//world); each
+    shard splits into chunk_elems-sized pieces (chunk_elems <= 0 keeps
+    one chunk per shard — the quant-without-chunking configuration).
+    Every shard gets at least one (possibly empty) chunk so the
+    rendezvous key schedule is uniform across ranks."""
+    plan = []
+    for o in range(world):
+        lo, hi = o * n // world, (o + 1) * n // world
+        if chunk_elems <= 0 or hi - lo <= chunk_elems:
+            plan.append([(lo, hi)])
+            continue
+        cuts = list(range(lo, hi, chunk_elems)) + [hi]
+        plan.append([(a, b) for a, b in zip(cuts, cuts[1:]) if a < b])
+    return plan
+
+
+def _fetch_order(g: _Group, peers: List[int]) -> "tuple[List[int], List[int]]":
+    """Chunk-fetch peer scheduling: returns ``(pipelined, deferred)``.
+    FIFO rank order normally; a peer whose EWMA arrival lag exceeds
+    ``collective_straggler_threshold`` is deferred — ALL its chunks are
+    fetched after every other peer's, so the known straggler's
+    not-yet-published keys never occupy the bounded pipeline window
+    while fast peers' chunks are ready to flow (arxiv 2505.23523). By
+    the time the window reaches a deferred peer its chunks have usually
+    landed, so the tail waits drain at poll speed. Threshold <= 0 (the
+    default-off flag) keeps pure FIFO."""
+    peers = sorted(peers)
+    thr = GLOBAL_CONFIG.collective_straggler_threshold
+    if thr <= 0 or not g.peer_lag:
+        return peers, []
+    laggy = [p for p in peers if g.peer_lag.get(p, 0.0) > thr]
+    if not laggy:
+        return peers, []
+    laggy.sort(key=lambda p: (g.peer_lag.get(p, 0.0), p))
+    return [p for p in peers if p not in set(laggy)], laggy
+
+
+def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
+                       seq: int, tel: dict, quant: str = "") -> np.ndarray:
+    """Allreduce ``arr`` over the store transport in owner-sharded chunks.
+
+    Rank o owns shard o. Every rank publishes its contribution chunks
+    for peer-owned shards; each owner accumulates a chunk as soon as all
+    contributions land and immediately republishes the reduced chunk,
+    while a bounded window of chunk waits keeps the next chunks' RPC
+    round trips in flight under the numpy work (reduce of chunk N
+    overlaps transport of chunk N+1). With ``quant="int8"`` the wire
+    carries per-chunk scale + int8; the owner dequantize-accumulates in
+    fp32, requantizes the reduced chunk, and uses the requantized wire
+    form for its OWN output too, so all ranks hold bit-identical
+    results. All rendezvous keys live under the op's seq prefix
+    (``<keybase>:<seq>:c[cr]:...``), so the existing rank-0 GC of the
+    previous seq and the PR 17 abort/epoch machinery cover chunked ops
+    unchanged."""
+    import concurrent.futures as cf
+
+    cw = _cw()
+    W, rank = g.world_size, g.rank
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    n, itemsize = flat.size, flat.dtype.itemsize
+    chunk_bytes = GLOBAL_CONFIG.collective_chunk_bytes
+    chunk_elems = max(1, chunk_bytes // itemsize) if chunk_bytes > 0 else 0
+    plan = _chunk_layout(n, W, chunk_elems)
+    gbase = [0] * W  # owner -> global chunk index of its chunk 0
+    for o in range(1, W):
+        gbase[o] = gbase[o - 1] + len(plan[o - 1])
+    prefix = f"{g.keybase}:{seq}"
+    abort_key = g.keybase.encode() + _ABORT_SUFFIX
+    depth = max(1, GLOBAL_CONFIG.collective_pipeline_depth)
+    ufunc = _ACC_UFUNC[op]
+    mean = op == ReduceOp.MEAN
+    deadline = time.monotonic() + timeout
+
+    if quant:
+        res_dtype = np.dtype(np.float32)
+    elif mean and flat.dtype.kind in "biu":
+        res_dtype = np.dtype(np.float64)  # np.mean-like int promotion
+    else:
+        res_dtype = flat.dtype
+    out = np.empty(n, dtype=res_dtype)
+
+    def fp_size(elems: int) -> int:
+        return elems * itemsize
+
+    put_futs: List = []
+
+    def aput(key: str, value, elems: int):
+        tel["wire"] += _vsize(value)
+        tel["logical"] += (_vsize(value) if not quant
+                           else _vsize(value) - elems + fp_size(elems))
+        put_futs.append(cw.io.submit(_akv_put(cw, key.encode(), value)))
+
+    # -- publish contributions for every peer-owned shard, chunk-major so
+    # each owner's chunk 0 is on the wire before anyone's chunk 1
+    rounds = max(len(pl) for pl in plan)
+    for ci in range(rounds):
+        for o in range(W):
+            if o == rank or ci >= len(plan[o]):
+                continue
+            lo, hi = plan[o][ci]
+            aput(f"{prefix}:cc:{o}:{ci}:{rank}",
+                 _enc_tensor(flat[lo:hi], quant), hi - lo)
+
+    # -- seed own-shard accumulators with this rank's own contribution
+    # (quantize-roundtripped when quant is on: the analytic error bound
+    # assumes every rank's contribution was quantized, owner included)
+    my_chunks = plan[rank]
+    acc: Dict[int, np.ndarray] = {}
+    remaining: Dict[int, int] = {}
+    chunk_t0: Dict[tuple, float] = {}
+    for ci, (lo, hi) in enumerate(my_chunks):
+        own = flat[lo:hi]
+        if quant:
+            q, sc = _quant_encode(own)
+            acc[ci] = _quant_decode(q, sc)
+        else:
+            acc[ci] = own.astype(res_dtype, copy=True)
+        remaining[ci] = W - 1
+
+    peer_first_t: Dict[int, float] = {}
+    t_base = time.time()  # our own publish moment: the lag baseline
+
+    def note_lag(p: int, hd: Optional[dict]):
+        if hd and "t" in hd:
+            t = hd["t"]
+            if p not in peer_first_t or t < peer_first_t[p]:
+                peer_first_t[p] = t
+
+    def finalize_chunk(ci: int):
+        lo, hi = my_chunks[ci]
+        value = acc[ci]
+        if mean:
+            value = value / W if quant else (value / W).astype(res_dtype)
+        if quant:
+            q, sc = _quant_encode(value)
+            enc = _enc_quant(q, sc, "float32", value.shape)
+            # peers decode the requantized wire form; so do we, for
+            # bit-identical results on every rank
+            out[lo:hi] = _quant_decode(q, sc)
+        else:
+            enc = _enc_tensor(value)
+            out[lo:hi] = value
+        aput(f"{prefix}:cr:{rank}:{ci}", enc, hi - lo)
+        now = time.time()
+        steptrace.record_chunk(g.trace_name, seq, gbase[rank] + ci, op,
+                               rank, chunk_t0.get(("cc", ci), now), now,
+                               fp_size(hi - lo))
+        _metrics()[3].inc()
+
+    # -- pipelined fetch loop: contributions to my shard + reduced chunks
+    # of peer shards, window-bounded. Normally interleaved chunk-major
+    # (matches the chunk-major publish order, so round N's keys are on
+    # the wire before anyone waits on round N+1). With a deferred
+    # (straggler) peer the schedule regroups: ALL contribution fetches
+    # first — they are the finalize inputs every peer's reduced chunks
+    # depend on, so a cc wait parked behind another rank's cr wait would
+    # deadlock the in-order windows of mutually-waiting ranks — then all
+    # reduced-chunk fetches; within each kind the straggler's chunks go
+    # globally last.
+    order, deferred = _fetch_order(g, [p for p in range(W) if p != rank])
+    items: List[tuple] = []
+    if not deferred:
+        for ci in range(rounds):
+            for p in order:
+                if ci < len(my_chunks):
+                    items.append(("cc", p, ci))
+                if ci < len(plan[p]):
+                    items.append(("cr", p, ci))
+    else:
+        for kind in ("cc", "cr"):
+            for batch in (order, deferred):
+                for ci in range(rounds):
+                    for p in batch:
+                        if kind == "cc" and ci < len(my_chunks):
+                            items.append(("cc", p, ci))
+                        elif kind == "cr" and ci < len(plan[p]):
+                            items.append(("cr", p, ci))
+
+    it = iter(items)
+    window: Dict = {}
+
+    def submit_next() -> bool:
+        item = next(it, None)
+        if item is None:
+            return False
+        kind, p, ci = item
+        if kind == "cc":
+            key = f"{prefix}:cc:{rank}:{ci}:{p}"
+            chunk_t0.setdefault((kind, ci), time.time())
+        else:
+            key = f"{prefix}:cr:{p}:{ci}"
+            chunk_t0.setdefault((kind, p, ci), time.time())
+        budget = max(0.01, deadline - time.monotonic())
+        window[cw.io.submit(_akv_wait(cw, key.encode(), budget,
+                                      abort_key))] = item
+        return True
+
+    try:
+        while len(window) < depth and submit_next():
+            pass
+        while window:
+            done, _ = cf.wait(list(window),
+                              return_when=cf.FIRST_COMPLETED)
+            for fut in done:
+                kind, p, ci = window.pop(fut)
+                value = fut.result()  # raises: abort/timeout unwedge
+                dec, hd = _dec_tensor(value)
+                note_lag(p, hd)
+                elems = dec.size
+                tel["wire"] += _vsize(value)
+                tel["logical"] += (_vsize(value) if not quant
+                                   else _vsize(value) - elems
+                                   + fp_size(elems))
+                if kind == "cc":
+                    ufunc(acc[ci], dec, out=acc[ci],
+                          casting="same_kind")
+                    remaining[ci] -= 1
+                    if remaining[ci] == 0:
+                        finalize_chunk(ci)
+                else:
+                    lo, hi = plan[p][ci]
+                    out[lo:hi] = dec
+                    now = time.time()
+                    steptrace.record_chunk(
+                        g.trace_name, seq, gbase[p] + ci, op, rank,
+                        chunk_t0.get(("cr", p, ci), now), now,
+                        fp_size(hi - lo))
+                    _metrics()[3].inc()
+            while len(window) < depth and submit_next():
+                pass
+        for fut in put_futs:
+            fut.result(max(0.01, deadline - time.monotonic()))
+    except BaseException:
+        for fut in window:
+            fut.cancel()
+        for fut in put_futs:
+            fut.cancel()
+        raise
+
+    # -- fold this op's arrival lags into the straggler EWMA
+    if peer_first_t:
+        base = min(min(peer_first_t.values()), t_base)
+        for p, t in peer_first_t.items():
+            lag = max(0.0, t - base)
+            old = g.peer_lag.get(p)
+            g.peer_lag[p] = lag if old is None else 0.7 * old + 0.3 * lag
+
+    # rank 0 garbage-collects the previous op's keys (chunk sub-keys
+    # live under the seq prefix, so the one delete covers both paths)
+    if rank == 0 and seq > 0:
+        _kv_del_prefix(f"{g.keybase}:{seq - 1}:".encode())
+    return out.reshape(arr.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -503,12 +1013,11 @@ def _store_xla_equivalent(g: _Group, op: str, arr: "np.ndarray",
         # empty marker (same cheap form as the native broadcast path) —
         # world x full-tensor KV traffic for a one-way op is waste
         (src,) = extra
-        payload = pickle.dumps(arr, protocol=5) if g.rank == src else b""
+        payload = _enc_tensor(arr) if g.rank == src else b""
         outs = _phase(g, "x" + op, timeout, payload, seq=seq)
-        return pickle.loads(outs[src])
-    outs = _phase(g, "x" + op, timeout, pickle.dumps(arr, protocol=5),
-                  seq=seq)
-    stacked = np.stack([pickle.loads(o) for o in outs])
+        return np.array(_dec_tensor(outs[src])[0])
+    outs = _phase(g, "x" + op, timeout, _enc_tensor(arr), seq=seq)
+    stacked = np.stack([_dec_tensor(o)[0] for o in outs])
     if op == "psum":
         return stacked.sum(axis=0)
     if op == "pmean":
@@ -556,11 +1065,28 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
               timeout: float = 120.0):
     """Allreduce across the group; returns the reduced tensor (jax arrays are
     immutable so the result is returned rather than written in place; numpy
-    inputs are also updated in place for drop-in parity)."""
+    inputs are also updated in place for drop-in parity).
+
+    Store-transport routing (also taken by xla groups once they degrade
+    to the KV ring on CPU): tensors above ``collective_chunk_bytes`` —
+    or any float SUM/MEAN when the group opted into quantization — take
+    the chunked reduce-scatter+allgather pipeline; everything else takes
+    the monolithic single-payload exchange (flags off == today's
+    behavior, pinned byte-identical by test)."""
     g = _group(group_name)
     arr = _to_numpy(tensor)
 
-    def _go(seq):
+    def _go(seq, tel):
+        store_path = g.backend == "store" or g.xla_fallback
+        if store_path and g.world_size > 1 and arr.dtype != object \
+                and arr.size > 0:
+            quant = ""
+            if op in (ReduceOp.SUM, ReduceOp.MEAN) and arr.dtype.kind == "f":
+                quant = g.quant or GLOBAL_CONFIG.collective_quant
+            chunk_bytes = GLOBAL_CONFIG.collective_chunk_bytes
+            if quant or (chunk_bytes > 0 and arr.nbytes > chunk_bytes):
+                return _chunked_allreduce(g, arr, op, timeout, seq, tel,
+                                          quant)
         if g.backend == "xla":
             if op == ReduceOp.PRODUCT:  # no pprod primitive: gather + prod
                 gathered = _xla_collective(g, "allgather", arr,
@@ -568,9 +1094,8 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
                 return np.prod(gathered, axis=0)
             return _xla_collective(g, _XLA_REDUCE[op], arr,
                                    timeout=timeout, seq=seq)
-        outs = _phase(g, "ar", timeout, pickle.dumps(arr, protocol=5),
-                      seq=seq)
-        return _REDUCERS[op](np.stack([pickle.loads(o) for o in outs]))
+        outs = _phase(g, "ar", timeout, _enc_tensor(arr), seq=seq, tel=tel)
+        return _REDUCERS[op](np.stack([_dec_tensor(o)[0] for o in outs]))
 
     result = _op(g, "allreduce", arr.nbytes, _go)
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
@@ -587,14 +1112,15 @@ def allgather(tensor, group_name: str = "default", timeout: float = 120.0):
     g = _group(group_name)
     arr = _to_numpy(tensor)
 
-    def _go(seq):
+    def _go(seq, tel):
         if g.backend == "xla":
             gathered = _xla_collective(g, "allgather", arr, timeout=timeout,
                                        seq=seq)
             return [gathered[r] for r in range(g.world_size)]
-        outs = _phase(g, "ag", timeout, pickle.dumps(arr, protocol=5),
-                      seq=seq)
-        return [pickle.loads(o) for o in outs]
+        outs = _phase(g, "ag", timeout, _enc_tensor(arr), seq=seq, tel=tel)
+        # gathered tensors escape to the caller: copy out of the rpc
+        # receive buffers (the frames would pin them otherwise)
+        return [np.array(_dec_tensor(o)[0]) for o in outs]
 
     return _op(g, "allgather", arr.nbytes, _go)
 
@@ -610,7 +1136,7 @@ def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
             f"leading dim {arr.shape[0]} not divisible by world size {g.world_size}"
         )
 
-    def _go(seq):
+    def _go(seq, tel):
         if g.backend == "xla":
             if op == ReduceOp.SUM:
                 return _xla_collective(g, "reducescatter", arr,
@@ -619,9 +1145,8 @@ def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
                                        seq=seq)
             reduced = _REDUCERS[op](gathered)
             return np.split(reduced, g.world_size, axis=0)[g.rank]
-        outs = _phase(g, "rs", timeout, pickle.dumps(arr, protocol=5),
-                      seq=seq)
-        reduced = _REDUCERS[op](np.stack([pickle.loads(o) for o in outs]))
+        outs = _phase(g, "rs", timeout, _enc_tensor(arr), seq=seq, tel=tel)
+        reduced = _REDUCERS[op](np.stack([_dec_tensor(o)[0] for o in outs]))
         return np.split(reduced, g.world_size, axis=0)[g.rank]
 
     return _op(g, "reducescatter", arr.nbytes, _go)
@@ -640,16 +1165,13 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
     else:
         arr, nbytes = None, 0
 
-    def _go(seq):
+    def _go(seq, tel):
         if g.backend == "xla":
             return _xla_collective(g, "broadcast", arr, extra=(src_rank,),
                                    timeout=timeout, seq=seq)
-        if g.rank == src_rank:
-            payload = pickle.dumps(arr, protocol=5)
-        else:
-            payload = b""
-        outs = _phase(g, "bc", timeout, payload, seq=seq)
-        return pickle.loads(outs[src_rank])
+        payload = _enc_tensor(arr) if g.rank == src_rank else b""
+        outs = _phase(g, "bc", timeout, payload, seq=seq, tel=tel)
+        return _dec_tensor(outs[src_rank])[0]
 
     result = _op(g, "broadcast", nbytes, _go)
     if isinstance(tensor, np.ndarray) and g.rank != src_rank:
@@ -661,12 +1183,12 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
 def barrier(group_name: str = "default", timeout: float = 120.0):
     g = _group(group_name)
 
-    def _go(seq):
+    def _go(seq, tel):
         if g.backend == "xla":
             _xla_collective(g, "psum", np.zeros((1,), np.float32),
                             timeout=timeout, seq=seq)
             return None
-        _phase(g, "barrier", timeout, b"1", seq=seq)
+        _phase(g, "barrier", timeout, b"1", seq=seq, tel=tel)
         return None
 
     _op(g, "barrier", 0, _go)
@@ -680,7 +1202,7 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     seq = g.p2p_send.get(dst_rank, 0)
     g.p2p_send[dst_rank] = seq + 1
     key = f"{g.keybase}:p2p:{seq}:{g.rank}->{dst_rank}".encode()
-    _kv_put(key, pickle.dumps(_to_numpy(tensor), protocol=5))
+    _kv_put(key, _enc_tensor(_to_numpy(tensor)), volatile=True)
 
 
 def recv(tensor, src_rank: int, group_name: str = "default",
@@ -689,7 +1211,7 @@ def recv(tensor, src_rank: int, group_name: str = "default",
     seq = g.p2p_recv.get(src_rank, 0)
     g.p2p_recv[src_rank] = seq + 1
     key = f"{g.keybase}:p2p:{seq}:{src_rank}->{g.rank}".encode()
-    data = pickle.loads(
+    data, _ = _dec_tensor(
         _kv_wait(key, timeout, abort_key=g.keybase.encode() + _ABORT_SUFFIX)
     )
     if isinstance(tensor, np.ndarray):

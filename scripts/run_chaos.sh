@@ -60,6 +60,13 @@ if [ "$rc" -ne 0 ]; then
         echo "--- train fault-tolerance counters (failures/restarts/recovery) ---" >&2
         grep -aE 'train_worker_failures_total|train_restarts_total|train_recovery_seconds' \
             "$out" >&2 || true
+        # collective-backend triage: wire-vs-logical byte counters show
+        # whether quantization was in play when the lane failed, and a
+        # high chunk-retry count fingers rendezvous churn (straggling or
+        # flapping ranks re-polling chunk keys) as the slow path
+        echo "--- collective transport counters (wire/logical bytes + chunk retries) ---" >&2
+        grep -aE 'collective_wire_bytes_total|collective_logical_bytes_total|collective_chunk_retries_total|collective_chunks_total' \
+            "$out" >&2 || true
         # transfer-plane triage: dead/punched byte gauges make stuck
         # reclamation visible, and the slab-vs-file put counters show a
         # silent fall-off from the arena data path

@@ -5,6 +5,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.collective
+
 
 @ray_tpu.remote
 class CollectiveWorker:

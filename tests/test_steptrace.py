@@ -130,7 +130,7 @@ def test_failed_collective_still_records():
 
     g = c._Group("failgrp", 2, 0, "store")
 
-    def boom(seq):
+    def boom(seq, tel):
         time.sleep(0.01)
         raise RuntimeError("peer never arrived")
 
