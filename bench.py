@@ -794,7 +794,10 @@ def _collective_main():
     the chunked/monolithic best-of-N speedup at the top size, world 2 —
     the tentpole number. Gates: chunked never slower than monolithic,
     int8 logical/wire >= 2x with error inside the per-block bound, and
-    straggler-aware p50 < FIFO p50 under injected skew. BENCH_SMALL
+    under injected skew the straggler-aware schedule retires the fast
+    peer's contribution chunks earlier than FIFO without costing wall
+    clock (op completion itself is bound by the slowest contributor, so
+    the lane does not gate on wall clock alone). BENCH_SMALL
     drops the 64MB size. Emits ONE JSON line, same contract as the
     default bench path."""
     import ray_tpu

@@ -288,19 +288,24 @@ _flag("collective_timeout_s", float, 120.0)
 # with reduction of chunk N overlapping transport of chunk N+1.
 # 0 disables chunking (monolithic single-payload _phase, today's path).
 _flag("collective_chunk_bytes", int, 1 << 20)
-# in-flight chunk-fetch window per shard during the reduce-scatter and
-# allgather phases (the pipeline depth that buys transport/reduce overlap)
+# in-flight chunk fetches per fetch kind (contribution fetches and
+# reduced-chunk fetches each get their own window of this depth, so
+# waits on unfinalized reduced chunks can never starve the contribution
+# fetches finalization depends on) — the pipeline depth that buys
+# transport/reduce overlap
 _flag("collective_pipeline_depth", int, 4)
 # EQuARX-style block-wise quantization for SUM/MEAN allreduce: "" (off)
 # or "int8" (per-chunk symmetric scale + int8 wire). Group-level opt-in
 # via create_collective_group(..., quant=) overrides this default.
 _flag("collective_quant", str, "")
 # straggler-tolerant chunk scheduling: when a peer's EWMA arrival lag
-# (seconds behind the fastest rank, learned from chunk-header put
-# timestamps) exceeds this, its chunks are fetched LAST so the bounded
-# pipeline window stays busy on ranks that have already published.
-# 0 disables reordering (FIFO rank order).
-_flag("collective_straggler_threshold", float, 0.005)
+# (seconds behind the fastest peer, measured from receiver-local chunk
+# wait times — never cross-host timestamps) exceeds this, its chunks
+# are fetched LAST so the bounded pipeline windows stay busy on ranks
+# that have already published. 0 (the default) disables reordering
+# (FIFO rank order); set well above the transport's RPC round-trip
+# floor when enabling.
+_flag("collective_straggler_threshold", float, 0.0)
 _flag("tpu_autodetect", bool, False)
 # RPC substrate (ray: grpc_server.h / client channel args)
 _flag("rpc_max_message_bytes", int, 1 << 31)

@@ -31,9 +31,10 @@ against the steptrace (group, seq) skew series PR 11 shipped):
    allgathered in fixed-size chunks — each rank OWNS 1/world of the
    tensor, peers publish their contribution chunks, the owner
    accumulates and republishes the reduced chunk as soon as its last
-   contribution lands, and a bounded in-flight window
-   (``collective_pipeline_depth``) keeps reduction of chunk N
-   overlapping the RPC round trips of chunk N+1. Chunk payloads ride
+   contribution lands, and bounded in-flight windows
+   (``collective_pipeline_depth``, one window per fetch kind) keep
+   reduction of chunk N overlapping the RPC round trips of chunk N+1.
+   Chunk payloads ride
    rpcio's v2 out-of-band buffer table (``BufferList``): tensor bytes
    are never copied into a pickle envelope.
 2. **Block-wise int8 quantization** (EQuARX-style, arxiv 2506.17615):
@@ -44,12 +45,15 @@ against the steptrace (group, seq) skew series PR 11 shipped):
    the SAME requantized wire form, so results stay bit-identical
    across ranks. Non-SUM/MEAN ops and non-float dtypes fall back to
    exact full-precision transport.
-3. **Straggler-tolerant chunk scheduling** (arxiv 2505.23523): chunk
-   headers carry producer put-timestamps; each peer's arrival lag is
-   folded into an EWMA, and a peer whose lag exceeds
+3. **Straggler-tolerant chunk scheduling** (arxiv 2505.23523): each
+   rank tracks the longest time it spent blocked on a peer's
+   contribution chunks, relative to the fastest peer (receiver-clock
+   only — no cross-host timestamp comparison, which NTP-grade clock
+   offset would poison), folds it into an EWMA, and a peer whose lag
+   exceeds
    ``collective_straggler_threshold`` has its chunks fetched LAST so
-   the pipeline window stays busy on ranks that have already
-   published (0 = FIFO rank order).
+   the pipeline windows stay busy on ranks that have already
+   published (0, the default = FIFO rank order).
 
 Telemetry: every op (allreduce/allgather/reducescatter/broadcast/barrier)
 consumes one per-group monotonic sequence number and records a steptrace
@@ -160,8 +164,8 @@ def _metrics():
 # ---------------------------------------------------------------------------
 #
 # A tensor payload is BufferList([header, body]): the pickled header
-# (dtype/shape/quant-scale/producer-timestamp, ~150B, stays in the pickle
-# envelope) and the raw tensor bytes, which rpcio's v2 framing sends
+# (dtype/shape/quant-scale, ~100B, stays in the pickle envelope) and
+# the raw tensor bytes, which rpcio's v2 framing sends
 # out-of-band by reference — no pickle.dumps copy of the tensor on the
 # send side, and a zero-copy memoryview over the read buffer on the
 # receive side. Object-dtype tensors (and b"" markers) stay plain bytes.
@@ -191,7 +195,7 @@ def _quant_decode(q: np.ndarray, scale: float) -> np.ndarray:
 
 
 def _wrap_body(hd_fields: dict, body_arr: np.ndarray) -> BufferList:
-    hd = pickle.dumps(dict(hd_fields, t=time.time()), protocol=5)
+    hd = pickle.dumps(hd_fields, protocol=5)
     # 1-D view keeps the memoryview cast-safe for 0-d/N-d inputs alike
     return BufferList([hd, memoryview(body_arr.reshape(-1)).cast("B")])
 
@@ -262,10 +266,17 @@ class _Group:
     # SUM/MEAN float allreduces on the store path (group-level opt-in;
     # the RAY_TPU_collective_quant flag is the process-wide default)
     quant: str = ""
-    # rank -> EWMA arrival lag (s) behind the op's fastest publisher,
-    # learned from chunk-header put timestamps; drives straggler-last
-    # chunk fetch ordering
+    # rank -> EWMA arrival lag (s) behind the op's fastest peer,
+    # learned from receiver-local chunk wait times; drives
+    # straggler-last chunk fetch ordering
     peer_lag: Dict[int, float] = field(default_factory=dict)
+    # rank -> seconds into the previous chunked op's fetch loop when
+    # that peer's LAST contribution chunk retired. Diagnostic for the
+    # straggler-scheduling A/B: op completion is always bound by the
+    # slowest contributor, but deferral retires fast peers' chunks
+    # UNDER the straggler's delay instead of serialized after it, and
+    # this is where that shows
+    peer_cc_done: Dict[int, float] = field(default_factory=dict)
     p2p_send: Dict[int, int] = None  # per-destination send counters
     p2p_recv: Dict[int, int] = None  # per-source recv counters
     mesh: object = None  # xla backend: 1-device-per-rank Mesh over axis "ranks"
@@ -657,9 +668,9 @@ def _fetch_order(g: _Group, peers: List[int]) -> "tuple[List[int], List[int]]":
     FIFO rank order normally; a peer whose EWMA arrival lag exceeds
     ``collective_straggler_threshold`` is deferred — ALL its chunks are
     fetched after every other peer's, so the known straggler's
-    not-yet-published keys never occupy the bounded pipeline window
+    not-yet-published keys never occupy the bounded pipeline windows
     while fast peers' chunks are ready to flow (arxiv 2505.23523). By
-    the time the window reaches a deferred peer its chunks have usually
+    the time a window reaches a deferred peer its chunks have usually
     landed, so the tail waits drain at poll speed. Threshold <= 0 (the
     default-off flag) keeps pure FIFO."""
     peers = sorted(peers)
@@ -680,8 +691,8 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
     Rank o owns shard o. Every rank publishes its contribution chunks
     for peer-owned shards; each owner accumulates a chunk as soon as all
     contributions land and immediately republishes the reduced chunk,
-    while a bounded window of chunk waits keeps the next chunks' RPC
-    round trips in flight under the numpy work (reduce of chunk N
+    while per-kind bounded windows of chunk waits keep the next chunks'
+    RPC round trips in flight under the numpy work (reduce of chunk N
     overlaps transport of chunk N+1). With ``quant="int8"`` the wire
     carries per-chunk scale + int8; the owner dequantize-accumulates in
     fp32, requantizes the reduced chunk, and uses the requantized wire
@@ -755,15 +766,6 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
             acc[ci] = own.astype(res_dtype, copy=True)
         remaining[ci] = W - 1
 
-    peer_first_t: Dict[int, float] = {}
-    t_base = time.time()  # our own publish moment: the lag baseline
-
-    def note_lag(p: int, hd: Optional[dict]):
-        if hd and "t" in hd:
-            t = hd["t"]
-            if p not in peer_first_t or t < peer_first_t[p]:
-                peer_first_t[p] = t
-
     def finalize_chunk(ci: int):
         lo, hi = my_chunks[ci]
         value = acc[ci]
@@ -786,42 +788,43 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
         _metrics()[3].inc()
 
     # -- pipelined fetch loop: contributions to my shard + reduced chunks
-    # of peer shards, window-bounded. Normally interleaved chunk-major
-    # (matches the chunk-major publish order, so round N's keys are on
-    # the wire before anyone waits on round N+1). With a deferred
-    # (straggler) peer the schedule regroups: ALL contribution fetches
-    # first — they are the finalize inputs every peer's reduced chunks
-    # depend on, so a cc wait parked behind another rank's cr wait would
-    # deadlock the in-order windows of mutually-waiting ranks — then all
-    # reduced-chunk fetches; within each kind the straggler's chunks go
-    # globally last.
+    # of peer shards. The two kinds draw from SEPARATE depth-bounded
+    # windows: a cr wait only completes after its owner finalized, i.e.
+    # after that owner fetched all W-1 contributions of its own — so cr
+    # waits parked in a shared in-order window ahead of not-yet-submitted
+    # cc items would starve every rank's contribution fetches as soon as
+    # W-1 > depth, and the mutually-waiting ranks would deadlock until
+    # the rendezvous timeout. Per-kind windows keep contribution fetches
+    # flowing regardless of how many reduced-chunk waits are pending,
+    # while the streams still interleave for transport/reduce overlap.
+    # Within each kind the schedule is chunk-major FIFO (matches the
+    # chunk-major publish order); a deferred (straggler) peer's chunks
+    # go globally last within its kind.
     order, deferred = _fetch_order(g, [p for p in range(W) if p != rank])
-    items: List[tuple] = []
-    if not deferred:
-        for ci in range(rounds):
-            for p in order:
-                if ci < len(my_chunks):
-                    items.append(("cc", p, ci))
-                if ci < len(plan[p]):
-                    items.append(("cr", p, ci))
-    else:
-        for kind in ("cc", "cr"):
-            for batch in (order, deferred):
-                for ci in range(rounds):
-                    for p in batch:
-                        if kind == "cc" and ci < len(my_chunks):
-                            items.append(("cc", p, ci))
-                        elif kind == "cr" and ci < len(plan[p]):
-                            items.append(("cr", p, ci))
 
-    it = iter(items)
+    def _sched(kind: str) -> List[tuple]:
+        out_items = []
+        for batch in (order, deferred):
+            for ci in range(rounds):
+                for p in batch:
+                    if kind == "cc" and ci < len(my_chunks):
+                        out_items.append((kind, p, ci))
+                    elif kind == "cr" and ci < len(plan[p]):
+                        out_items.append((kind, p, ci))
+        return out_items
+
+    iters = {kind: iter(_sched(kind)) for kind in ("cc", "cr")}
+    inflight = {"cc": 0, "cr": 0}
     window: Dict = {}
+    peer_ccw: Dict[int, float] = {}  # peer -> max cc wait observed (s)
+    peer_cc_done: Dict[int, float] = {}  # peer -> last cc retire offset (s)
+    loop_t0 = time.monotonic()
 
-    def submit_next() -> bool:
-        item = next(it, None)
+    def submit_next(kind: str) -> bool:
+        item = next(iters[kind], None)
         if item is None:
             return False
-        kind, p, ci = item
+        _, p, ci = item
         if kind == "cc":
             key = f"{prefix}:cc:{rank}:{ci}:{p}"
             chunk_t0.setdefault((kind, ci), time.time())
@@ -829,21 +832,30 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
             key = f"{prefix}:cr:{p}:{ci}"
             chunk_t0.setdefault((kind, p, ci), time.time())
         budget = max(0.01, deadline - time.monotonic())
-        window[cw.io.submit(_akv_wait(cw, key.encode(), budget,
-                                      abort_key))] = item
+        fut = cw.io.submit(_akv_wait(cw, key.encode(), budget, abort_key))
+        window[fut] = (kind, p, ci, time.monotonic())
+        inflight[kind] += 1
         return True
 
+    def fill_windows():
+        for kind in ("cc", "cr"):
+            while inflight[kind] < depth and submit_next(kind):
+                pass
+
     try:
-        while len(window) < depth and submit_next():
-            pass
+        fill_windows()
         while window:
             done, _ = cf.wait(list(window),
                               return_when=cf.FIRST_COMPLETED)
             for fut in done:
-                kind, p, ci = window.pop(fut)
+                kind, p, ci, t_sub = window.pop(fut)
+                inflight[kind] -= 1
                 value = fut.result()  # raises: abort/timeout unwedge
-                dec, hd = _dec_tensor(value)
-                note_lag(p, hd)
+                dec, _hd = _dec_tensor(value)
+                now_m = time.monotonic()
+                if kind == "cc":
+                    peer_ccw[p] = max(peer_ccw.get(p, 0.0), now_m - t_sub)
+                    peer_cc_done[p] = now_m - loop_t0
                 elems = dec.size
                 tel["wire"] += _vsize(value)
                 tel["logical"] += (_vsize(value) if not quant
@@ -864,8 +876,7 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
                         chunk_t0.get(("cr", p, ci), now), now,
                         fp_size(hi - lo))
                     _metrics()[3].inc()
-            while len(window) < depth and submit_next():
-                pass
+            fill_windows()
         for fut in put_futs:
             fut.result(max(0.01, deadline - time.monotonic()))
     except BaseException:
@@ -875,13 +886,31 @@ def _chunked_allreduce(g: _Group, arr: np.ndarray, op: str, timeout: float,
             fut.cancel()
         raise
 
-    # -- fold this op's arrival lags into the straggler EWMA
-    if peer_first_t:
-        base = min(min(peer_first_t.values()), t_base)
-        for p, t in peer_first_t.items():
-            lag = max(0.0, t - base)
+    # -- fold this op's per-peer cc waits into the straggler EWMA.
+    # Lag is measured entirely on the RECEIVER's clock: the longest
+    # time this rank spent blocked on one of a peer's CONTRIBUTION
+    # chunks, relative to the fastest peer's floor (which subtracts the
+    # shared RPC/poll round trip; with a single peer there is no
+    # reference and the raw wait stands in). Contributions are
+    # published at the peer's op entry, so the max cc wait tracks
+    # arrival lateness even when a late peer then publishes everything
+    # in a burst (its LATER chunks complete instantly — a min- or
+    # mean-style statistic would wash the signal out). Reduced-chunk
+    # waits are excluded: an owner's cr publish is gated on OTHER
+    # ranks' inputs, so counting it would charge fast owners with a
+    # straggler's delay. Producer-side header timestamps are never
+    # compared — ordinary NTP-grade cross-host clock offset exceeds
+    # any useful threshold and would fabricate (or mask) stragglers. A
+    # deferred peer's chunks are fetched last and usually land
+    # pre-published, so its measured lag shrinks and a rehabilitated
+    # peer drifts back under the threshold within a few ops.
+    if peer_ccw:
+        base = min(peer_ccw.values()) if len(peer_ccw) > 1 else 0.0
+        for p, w in peer_ccw.items():
+            lag = max(0.0, w - base)
             old = g.peer_lag.get(p)
             g.peer_lag[p] = lag if old is None else 0.7 * old + 0.3 * lag
+    g.peer_cc_done = peer_cc_done
 
     # rank 0 garbage-collects the previous op's keys (chunk sub-keys
     # live under the seq prefix, so the one delete covers both paths)
@@ -1171,13 +1200,18 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
                                    timeout=timeout, seq=seq)
         payload = _enc_tensor(arr) if g.rank == src_rank else b""
         outs = _phase(g, "bc", timeout, payload, seq=seq, tel=tel)
-        return _dec_tensor(outs[src_rank])[0]
+        # copy out of the rpc receive buffer: the decode is a read-only
+        # view that would otherwise pin the frame (and surprise callers
+        # who got owned writable arrays from the old pickle path)
+        return np.array(_dec_tensor(outs[src_rank])[0])
 
     result = _op(g, "broadcast", nbytes, _go)
-    if isinstance(tensor, np.ndarray) and g.rank != src_rank:
+    if g.rank == src_rank:
+        return tensor
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result.astype(tensor.dtype, copy=False))
         return tensor
-    return result if g.rank != src_rank else tensor
+    return result
 
 
 def barrier(group_name: str = "default", timeout: float = 120.0):
@@ -1214,7 +1248,9 @@ def recv(tensor, src_rank: int, group_name: str = "default",
     data, _ = _dec_tensor(
         _kv_wait(key, timeout, abort_key=g.keybase.encode() + _ABORT_SUFFIX)
     )
-    if isinstance(tensor, np.ndarray):
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, data.astype(tensor.dtype, copy=False))
         return tensor
-    return data
+    # escaping result: own it — the decode may be a read-only view over
+    # the rpc receive frame
+    return np.array(data)

@@ -136,6 +136,31 @@ def test_chunked_matches_reference(fake_cw, world, op):
         assert tel["wire"] == tel["logical"] > 0
 
 
+@pytest.mark.parametrize("world,depth", [(4, 2), (6, 4)])
+def test_deep_world_no_window_deadlock(fake_cw, world, depth):
+    """Regression: with world - 1 > pipeline depth, a SHARED in-order
+    fetch window fills up with reduced-chunk waits (which only complete
+    after their owner finalizes) before all contribution fetches are
+    submitted; no owner ever collects its W-1 contributions and every
+    rank blocks until the rendezvous timeout. The per-kind windows must
+    complete promptly at any world size, including depth 4 (the
+    default) at world 6."""
+    old_depth = cfg.collective_pipeline_depth
+    cfg.update({"collective_pipeline_depth": depth})
+    try:
+        rng = np.random.RandomState(world * 31 + depth)
+        arrays = [rng.randn(257).astype(np.float32) for _ in range(world)]
+        t0 = time.monotonic()
+        outs = _run_world(world, arrays, "sum", chunk_bytes=64,
+                          name=f"deep-{world}-{depth}", timeout=20.0)
+        assert time.monotonic() - t0 < 15.0, "chunk windows wedged"
+        ref = np.sum(np.stack(arrays), axis=0)
+        for out, _ in outs:
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        cfg.update({"collective_pipeline_depth": old_depth})
+
+
 def test_chunked_int_mean_promotes_like_numpy(fake_cw):
     arrays = [np.arange(10, dtype=np.int64),
               np.arange(10, dtype=np.int64) * 3]
@@ -242,7 +267,11 @@ def test_fetch_order_fifo_until_threshold(fake_cw):
     assert colmod._fetch_order(g, peers) == ([1, 2, 3], [])  # 0 = FIFO
 
 
-def test_straggler_ewma_learns_from_chunk_headers(fake_cw):
+def test_straggler_ewma_learns_from_local_wait_times(fake_cw):
+    """Lag is learned from how long THIS rank sat blocked on a peer's
+    contribution chunks (receiver clock only) — a peer entering the op
+    late shows up as a long max cc wait, with no cross-host timestamp
+    comparison."""
     arrays = [np.random.RandomState(r).randn(4096).astype(np.float32)
               for r in range(2)]
     cfg.update({"collective_straggler_threshold": 0.005})
@@ -260,15 +289,23 @@ def test_straggler_ewma_learns_from_chunk_headers(fake_cw):
             errs[r] = e
 
     threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
-    cfg.update({"collective_chunk_bytes": 2048})
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(60)
+    # shallow window + many chunks: once the late rank publishes its
+    # burst, every chunk AFTER the window refills completes instantly —
+    # only the max cc wait still carries the arrival-lateness signal
+    old_depth = cfg.collective_pipeline_depth
+    cfg.update({"collective_chunk_bytes": 1024,
+                "collective_pipeline_depth": 2})
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    finally:
+        cfg.update({"collective_pipeline_depth": old_depth})
     assert not any(errs), errs
     np.testing.assert_allclose(results[0], np.sum(np.stack(arrays), axis=0),
                                rtol=1e-5)
-    # rank 0 observed rank 1's headers arriving late -> learned lag
+    # rank 0 sat blocked on rank 1's chunks for ~the sleep -> learned lag
     assert groups[0].peer_lag.get(1, 0.0) > 0.05
     # ...which flips its next fetch order to straggler-last (trivially
     # [1] at world 2, but the EWMA is now over threshold)
