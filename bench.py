@@ -696,6 +696,188 @@ def _serve_load_main():
     os._exit(0)
 
 
+def _llm_serve_main():
+    """BENCH_LLM_SERVE=1: the LLM serving acceptance lane — an open-loop
+    session-keyed token-streaming client (BENCH_LLM_RPS offered rate,
+    heterogeneous max_tokens so drain's shrinking batch is real) against
+    a 2-replica LLMServer deployment through the real proxy, A/B:
+    batching="drain" (classic batch serving, the baseline) vs
+    "continuous" (iteration-level admission). Gates: at mean concurrency
+    >=8, continuous TTFT p50 improves on drain, tokens/s >= 1.5x drain,
+    prefix-cache hit rate > 0 under session-keyed traffic, and the KV
+    pages are arena-backed (np.shares_memory zero-copy proof via the
+    replica). Emits ONE JSON line + BENCH_LLM_SERVE.json."""
+    import asyncio
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import metrics_core
+
+    small = bool(os.environ.get("BENCH_SMALL"))
+    # offered rate must SATURATE the drain arm (capacity ~230 tok/s at
+    # these knobs) so its shrinking-batch loss shows up in throughput,
+    # while staying under the continuous arm's ~800 tok/s
+    rps = float(os.environ.get("BENCH_LLM_RPS", "40" if small else "32"))
+    duration = float(os.environ.get("BENCH_LLM_DURATION",
+                                    "4" if small else "8"))
+    sessions = int(os.environ.get("BENCH_LLM_SESSIONS", "4"))
+    step_delay = float(os.environ.get("BENCH_LLM_STEP_DELAY", "0.02"))
+
+    def _pcts(vals):
+        from ray_tpu.serve.load_harness import percentiles
+
+        return percentiles(vals)
+
+    async def wave(url):
+        """Open-loop: i-th request at t0 + i/rps; prompts keyed to one
+        of ``sessions`` shared contexts; max_tokens skewed (one 64-token
+        straggler per 8-cycle, the rest 6..18) so a drain batch idles
+        most of its slots waiting for the long sequence."""
+        import aiohttp
+
+        n = max(1, int(rps * duration))
+        interval = 1.0 / rps
+        results = []  # (ok, latency, ttft, tokens)
+        errors = {}
+        t0 = time.perf_counter()
+
+        async def one(i, sess):
+            delay = t0 + i * interval - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            s = i % sessions
+            body = json.dumps({
+                "prompt": f"session{s} " + " ".join(
+                    f"ctx{s}w{j}" for j in range(24)),
+                "max_tokens": 64 if i % 8 == 0 else 4 + (i % 8) * 2,
+            }).encode()
+            t_send = time.perf_counter()
+            ttft, toks = None, 0
+            try:
+                async with sess.post(url, data=body) as resp:
+                    if resp.status != 200:
+                        k = f"http_{resp.status}"
+                        errors[k] = errors.get(k, 0) + 1
+                        results.append((False, 0.0, None, 0))
+                        return
+                    async for line in resp.content:
+                        if line.strip():
+                            if ttft is None:
+                                ttft = time.perf_counter() - t_send
+                            toks += 1
+                results.append(
+                    (True, time.perf_counter() - t_send, ttft, toks))
+            except Exception as e:  # noqa: BLE001 — tally, keep offering
+                errors[type(e).__name__] = \
+                    errors.get(type(e).__name__, 0) + 1
+                results.append(
+                    (False, time.perf_counter() - t_send, ttft, toks))
+
+        conn = aiohttp.TCPConnector(limit=512)
+        tmo = aiohttp.ClientTimeout(total=120)
+        async with aiohttp.ClientSession(connector=conn,
+                                         timeout=tmo) as sess:
+            await asyncio.gather(*(one(i, sess) for i in range(n)))
+        wall = time.perf_counter() - t0
+        ok_rows = [r for r in results if r[0]]
+        tokens = sum(r[3] for r in results)
+        lat = [r[1] for r in ok_rows]
+        return {
+            "requests": n,
+            "ok": len(ok_rows),
+            "errors": errors,
+            "wall_s": round(wall, 3),
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+            "ttft_ms": {k: round(v * 1e3, 2) for k, v in
+                        _pcts([r[2] for r in ok_rows
+                               if r[2] is not None]).items()
+                        if k != "count"},
+            "latency_ms": {k: round(v * 1e3, 2)
+                           for k, v in _pcts(lat).items()
+                           if k != "count"},
+            # offered-load concurrency (Little's law on achieved traffic)
+            "mean_concurrency": round(sum(lat) / wall, 1) if wall else 0.0,
+        }
+
+    def scrape(name):
+        from ray_tpu.util import metrics as m
+
+        entry = metrics_core.summarize(
+            m.cluster_snapshot().get("merged", {})).get(name)
+        if not entry:
+            return {}
+        return {tuple(sorted((s.get("tags") or {}).items())):
+                s.get("value", 0.0) for s in entry["series"]}
+
+    from ray_tpu.serve.llm import LLMServer
+
+    def run_arm(batching):
+        dep = serve.deployment(LLMServer, name="llm_bench").options(
+            num_replicas=2, max_ongoing_requests=512)
+        h = serve.run(
+            dep.bind(page_tokens=8, max_pages=256, max_running=8,
+                     max_queued=128, batching=batching,
+                     prefix_cache_pages=64, step_delay_s=step_delay),
+            name="llm_bench", route_prefix="/llm_bench")
+        url = f"http://127.0.0.1:{serve.http_port()}/llm_bench"
+        out = asyncio.run(wave(url))
+        out["hit_rate"] = max(
+            [v for v in scrape("kv_cache_hit_rate").values()] or [0.0])
+        info = ray_tpu.get(
+            h.options(method_name="debug_info").remote().ref)
+        proof = ray_tpu.get(
+            h.options(method_name="debug_zero_copy").remote().ref)
+        out["arena_backed"] = bool(info["arena_backed"])
+        out["zero_copy"] = proof
+        serve.delete("llm_bench")
+        return out
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.start()
+        drain = run_arm("drain")
+        cont = run_arm("continuous")
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    tput_ratio = (cont["tokens_per_s"] / drain["tokens_per_s"]
+                  if drain["tokens_per_s"] else 0.0)
+    gates = {
+        "concurrency_ge_8": cont["mean_concurrency"] >= 8,
+        "ttft_p50_improves": (cont["ttft_ms"].get("p50", 1e9)
+                              < drain["ttft_ms"].get("p50", 0.0)),
+        "tokens_per_s_1p5x": tput_ratio >= 1.5,
+        "prefix_hit_rate_gt_0": cont["hit_rate"] > 0,
+        "kv_arena_zero_copy": (cont["arena_backed"]
+                               and cont["zero_copy"].get("shares_memory")
+                               and cont["zero_copy"].get("oid_prefix_ok")),
+    }
+    rec = {
+        "metric": "llm_serve_tokens_per_s_continuous_vs_drain",
+        "value": cont["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(tput_ratio, 3),
+        "detail": {
+            "offered_rps": rps, "duration_s": duration,
+            "sessions": sessions, "step_delay_s": step_delay,
+            "gates": gates, "all_pass": all(gates.values()),
+            "continuous": cont, "drain": drain,
+        },
+    }
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_LLM_SERVE.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass
+    print(json.dumps(rec), flush=True)
+    os._exit(0)
+
+
 def _object_plane_main():
     """BENCH_OBJECT_PLANE=1: the slab-arena acceptance lane — same-node
     put/get at 100B/64KB/1MB/64MB with p50/p95/p99 (PR 6 histogram
@@ -895,6 +1077,8 @@ def main():
         _reqtrace_overhead_main()
     if os.environ.get("BENCH_SERVE_LOAD"):
         _serve_load_main()
+    if os.environ.get("BENCH_LLM_SERVE"):
+        _llm_serve_main()
     if os.environ.get("BENCH_OBJECT_PLANE"):
         _object_plane_main()
     if os.environ.get("BENCH_CONTROL_PLANE"):

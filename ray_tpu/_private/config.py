@@ -352,6 +352,23 @@ _flag("serve_default_graceful_shutdown_timeout_s", float, 5.0)
 # inflight counts only) — a wedged controller's stale snapshot must not
 # keep steering traffic at a replica that has since filled up.
 _flag("serve_replica_report_max_age_s", float, 5.0)
+# LLM serving engine (serve/llm): continuous batching over an arena-
+# paged KV cache with prefix-affinity routing. serve_llm_enabled=0
+# disables every LLM-specific code path (handle-side prefix biasing,
+# LLMServer construction); plain deployments never touch these either
+# way. Page geometry: page_tokens tokens per page, kv_dim float32s per
+# token; kv_pages is the per-replica page budget admission control
+# guards. prefix_digest_max caps the chain hashes a replica reports in
+# the controller load probe (wire-size bound on the affinity signal).
+_flag("serve_llm_enabled", bool, True)
+_flag("serve_llm_page_tokens", int, 16)
+_flag("serve_llm_kv_dim", int, 64)
+_flag("serve_llm_kv_pages", int, 512)
+_flag("serve_llm_max_running", int, 8)
+_flag("serve_llm_max_queued", int, 32)
+_flag("serve_llm_prefix_cache_pages", int, 128)
+_flag("serve_llm_prefix_digest_max", int, 256)
+_flag("serve_llm_real_model", bool, False)
 # Request observatory (reqtrace.py): per-request serve phase tracing.
 # reqtrace_enabled gates every record path (zero-cost off, same posture
 # as metrics/steptrace/memview); the ring holds the newest
@@ -378,6 +395,15 @@ _flag("train_recovery_enabled", bool, True)
 # SIGTERM drain: how long a worker may run past the signal to reach the
 # next step boundary and checkpoint before it hard-exits
 _flag("train_drain_grace_s", float, 30.0)
+# In-graph gradient collective mode for build_train_step: "" lets the
+# XLA partitioner insert the reduction from shardings (default,
+# byte-identical to the pre-flag path); "chunked" splits the psum into
+# train_ingraph_psum_chunks collectives for latency hiding; "quantized"
+# rides the int8 wire format (parallel/collectives.py twins). Usually
+# set per-run via JaxConfig(ingraph_psum=...), which fans it out to the
+# worker gang.
+_flag("train_ingraph_psum", str, "")
+_flag("train_ingraph_psum_chunks", int, 4)
 
 
 GLOBAL_CONFIG = _Config()

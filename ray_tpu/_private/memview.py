@@ -119,10 +119,37 @@ def reset():
     with _lock:
         _puts.clear()
         _puts_max = 0
+        _ext_pins.clear()
     _flow_ring = []
     _flow_size = 0
     _flow_idx = 0
     _events = 0
+
+
+# ---------------------------------------------------------------------------
+# external pins: store-resident bytes a process holds OUTSIDE the
+# ObjectRef world (e.g. a serving replica's arena-backed KV pages).
+# Pinned oids join the process's ``referenced`` snapshot set, so the
+# cluster merge sees the holder — an unpinned-yet-undeleted page then
+# ages into a leak verdict exactly like an unreferenced object.
+# ---------------------------------------------------------------------------
+
+_ext_pins: set = set()
+
+
+def pin_external(oid: bytes):
+    with _lock:
+        _ext_pins.add(bytes(oid))
+
+
+def unpin_external(oid: bytes):
+    with _lock:
+        _ext_pins.discard(bytes(oid))
+
+
+def external_pins() -> list:
+    with _lock:
+        return list(_ext_pins)
 
 
 def _limits():

@@ -747,16 +747,27 @@ class LocalObjectStore:
         """A writer died: adopt the sealed prefix of every slab it still
         leased (unreported entries included; the torn mid-put tail, if
         any, is discarded by the scan) and make the segments evictable.
-        Returns newly adopted oids for location registration."""
+        Returns newly adopted oids for location registration.
+
+        KV pages (``KVPG`` oid prefix, serve/llm/kv_cache.py) are the
+        exception: a dead replica's KV cache is cache, not data — no
+        process can ever reference those oids again, so adopting them
+        would park them in the ledger until they aged into leak
+        verdicts. They go straight to dead ranges (and the PUNCH_HOLE
+        sweep) instead."""
         new: List[bytes] = []
         if not self.arena_enabled:
             return new
+        kv_prefix = slab_arena.KV_PAGE_OID_PREFIX
         with self._lock:
             for seg in list(self._segments.values()):
                 if seg.leased_to != client_id:
                     continue
                 before = set(seg.live)
                 end = self._reconcile_segment_locked(seg)
+                for oid in [o for o in seg.live
+                            if o.binary().startswith(kv_prefix)]:
+                    self._delete_locked(oid)
                 new.extend(o.binary() for o in seg.live - before)
                 used = slab_arena.align_up(end)
                 if seg.size > used:

@@ -72,6 +72,12 @@ HDR = 80
 STATE_SEALED = b"RTPUSLB1"
 STATE_DEAD = b"RTPUSLBX"
 
+# oid namespace for serving-engine KV pages (serve/llm/kv_cache.py):
+# entries in this namespace are CACHE, not data — the store's dead-
+# writer reclaim sends them to dead ranges instead of adopting them,
+# because no process can ever reference a dead replica's pages again
+KV_PAGE_OID_PREFIX = b"KVPG"
+
 IDX_MAGIC = b"RTPUIDX1"
 IDX_HDR = 64
 IDX_SLOT = 64

@@ -1907,6 +1907,9 @@ class CoreWorker:
         referenced.update(oid.hex() for oid in pins)
         referenced.update(oid.hex() for oid in contains)
         referenced.update(oid.hex() for oid in owned)
+        # bytes held outside the ObjectRef world (arena KV pages etc.):
+        # the holder must appear referenced or live pages read as leaks
+        referenced.update(o.hex() for o in memview.external_pins())
         return {"owned": rows, "referenced": sorted(referenced)}
 
     async def rpc_pubsub(self, conn: Connection, p):

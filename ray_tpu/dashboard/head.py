@@ -221,6 +221,21 @@ def _build_app():
         )
         return _json_response(out)
 
+    @routes.get("/api/v0/serve_llm")
+    async def serve_llm(request):
+        """LLM serving slice of the cluster metrics scrape: KV page-state
+        gauges, per-replica prefix hit rate, batch occupancy, token/shed
+        counters — the same numbers `ray_tpu serve llm` prints."""
+        from ray_tpu.util import metrics as m
+
+        def _slice():
+            return {name: entry.get("series", [])
+                    for name, entry in m.metrics_summary().items()
+                    if name.startswith(("kv_cache", "serve_llm"))}
+
+        out = await asyncio.get_running_loop().run_in_executor(None, _slice)
+        return _json_response(out)
+
     def _prom_text() -> str:
         """Merged cluster scrape (runtime + user metrics via the GCS
         fan-out) + synthesized cluster built-ins, as one exposition."""

@@ -272,23 +272,79 @@ def make_train_state(config: GPT2Config, rng, learning_rate: float = 3e-4,
     return model, params, tx, tx.init(params)
 
 
-def build_train_step(model, tx, donate: bool = True):
+def build_train_step(model, tx, donate: bool = True, *,
+                     mesh: Optional[Mesh] = None,
+                     batch_axis: str = "data",
+                     ingraph_psum: Optional[str] = None,
+                     psum_chunks: Optional[int] = None):
     """Jitted (params, opt_state, batch) -> (params, opt_state, loss).
 
-    Sharding is inferred from the placed arguments (use
+    Default path: sharding is inferred from the placed arguments (use
     ``shard_train_state`` / ``shard_batch`` first): with batch sharded over
     data axes and params replicated (DP) or fsdp-sharded (ZeRO-3), the XLA
     partitioner inserts the gradient psum / reduce-scatter on ICI — the
     TPU-native replacement for the reference's NCCL-DDP allreduce.
-    """
 
-    def step(params, opt_state, batch):
+    ``ingraph_psum`` (or the ``train_ingraph_psum`` flag, usually armed
+    per-run via ``JaxConfig(ingraph_psum=...)``) swaps the partitioner-
+    inserted reduction for an EXPLICIT collective inside shard_map over
+    ``mesh``: "chunked" splits each gradient allreduce into
+    ``psum_chunks`` collectives XLA's latency-hiding scheduler can start
+    early (parallel/collectives.py chunked_psum); "quantized" rides the
+    int8 wire format (quantized_psum) for ~4x fewer cross-ICI bytes per
+    fp32 gradient. Both reduce to the MEAN over ``batch_axis``, matching
+    the DP semantics of the default path. Flag unset + no explicit mode
+    = the original jit, byte-identical.
+    """
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+    mode = _cfg.train_ingraph_psum if ingraph_psum is None else ingraph_psum
+    if mode and mesh is None:
+        raise ValueError(
+            f"ingraph_psum={mode!r} needs an explicit mesh: the collective "
+            "runs inside shard_map, which cannot be inferred from placement")
+
+    if not mode:
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    from ray_tpu.parallel import collectives as col
+
+    chunks = int(psum_chunks if psum_chunks is not None
+                 else _cfg.train_ingraph_psum_chunks)
+    n = mesh.shape[batch_axis]
+    if mode == "chunked":
+        def reduce_grad(g):
+            return col.chunked_psum(g, batch_axis, chunks=chunks) / n
+    elif mode == "quantized":
+        def reduce_grad(g):
+            return col.quantized_psum(g, batch_axis, mean=True)
+    else:
+        raise ValueError(f"unknown ingraph_psum mode: {mode!r}")
+
+    def local_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, model, batch)
+        grads = jax.tree.map(reduce_grad, grads)
+        loss = jax.lax.pmean(loss, batch_axis)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    from ray_tpu.parallel.collectives import shard_map_norep
+
+    bspec = PartitionSpec(batch_axis)
+    fn = shard_map_norep(
+        local_step, mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec(),
+                  {"input_ids": bspec, "labels": bspec}),
+        out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec()),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 def build_train_step_sp(model, tx, mesh: Mesh, *, sp_axis: str = "sp",

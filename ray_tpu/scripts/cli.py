@@ -673,6 +673,86 @@ def cmd_serve_status(args):
         print(f"{app}: {info}")
 
 
+def cmd_serve_llm(args):
+    """LLM serving observatory: per-replica sequence load + prefix-digest
+    size from the controller's load reports, and the cluster-scraped KV
+    cache gauges (page states, per-replica hit rate, token/shed
+    counters)."""
+    import ray_tpu
+    from ray_tpu.serve._common import SERVE_CONTROLLER_NAME, SERVE_NAMESPACE
+    from ray_tpu._private import metrics_core
+    from ray_tpu.util import metrics as m
+
+    ray_tpu.init(address=_resolve_address(args), namespace=SERVE_NAMESPACE,
+                 ignore_reinit_error=True)
+    try:
+        try:
+            controller = ray_tpu.get_actor(SERVE_CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+        except Exception:
+            print("no Serve controller (is serve running?)")
+            return
+        status = ray_tpu.get(controller.get_serve_status.remote(),
+                             timeout=30)
+        dump = {"deployments": [], "metrics": {}}
+        found = False
+        for app, info in (status or {}).items():
+            for dep in (info.get("deployments") or {}):
+                st = ray_tpu.get(
+                    controller.get_replica_state.remote(app, dep),
+                    timeout=30)
+                llm = st.get("llm") or {}
+                if not llm:
+                    continue
+                found = True
+                age = st.get("loads_age_s")
+                print(f"{app}/{dep} (report age "
+                      f"{age:.1f}s):" if age is not None
+                      else f"{app}/{dep}:")
+                for name, blk in sorted(llm.items()):
+                    digest = blk.get("prefix_digest") or ()
+                    print(f"  replica {name}: "
+                          f"queued={blk.get('queued_seqs', 0)} "
+                          f"running={blk.get('running_seqs', 0)} "
+                          f"block_tokens={blk.get('block_tokens', 0)} "
+                          f"cached_prefix_blocks={len(digest)}")
+                dump["deployments"].append(
+                    {"app": app, "deployment": dep, "loads_age_s": age,
+                     "replicas": {n: {k: (len(v) if k == "prefix_digest"
+                                          else v)
+                                      for k, v in blk.items()}
+                                  for n, blk in llm.items()}})
+        if not found:
+            print("no LLM deployments reporting (engine.LLMServer "
+                  "replicas publish via the controller load probe)")
+        summary = metrics_core.summarize(
+            m.cluster_snapshot().get("merged", {}))
+        names = ("kv_cache_pages", "kv_cache_hit_rate",
+                 "serve_llm_batch_size", "serve_llm_tokens_total",
+                 "serve_llm_shed_total")
+        for name in names:
+            entry = summary.get(name)
+            if not entry:
+                continue
+            dump["metrics"][name] = entry["series"]
+            parts = []
+            for s in entry["series"]:
+                tags = ",".join(f"{k}={v}"
+                                for k, v in sorted(
+                                    (s.get("tags") or {}).items()))
+                val = s.get("value", 0.0)
+                sval = f"{val:.3f}" if name == "kv_cache_hit_rate" \
+                    else f"{val:g}"
+                parts.append(f"{{{tags}}}={sval}" if tags else sval)
+            print(f"  {name}: " + "  ".join(parts))
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(dump, f, indent=2, default=str)
+            print(f"llm serving dump -> {args.output}")
+    finally:
+        ray_tpu.shutdown()
+
+
 def _fmt_ms(v) -> str:
     return f"{v * 1e3:.1f}ms" if v is not None else "-"
 
@@ -1064,6 +1144,15 @@ def main(argv=None):
     sp = ssub.add_parser("status")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve_status)
+    sp = ssub.add_parser(
+        "llm",
+        help="LLM serving observatory: per-replica sequence load + "
+             "prefix-digest size, KV page-state gauges, hit rate, "
+             "token/shed counters")
+    sp.add_argument("-o", "--output",
+                    help="write the full JSON dump here")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_llm)
     sp = ssub.add_parser(
         "requests",
         help="request observatory: per-deployment latency breakdown, "

@@ -30,6 +30,38 @@ def _default_graceful_shutdown_s() -> float:
     return GLOBAL_CONFIG.serve_default_graceful_shutdown_timeout_s
 
 
+class OverloadedError(Exception):
+    """Typed load-shed: admission control rejected the request before it
+    could wedge a replica (bounded queue / KV budget exhausted). The
+    HTTP proxy maps this to 503 + Retry-After instead of the generic
+    500; the marker token survives cross-process exception stringifying
+    so the proxy can classify a re-raised copy too."""
+
+    MARKER = "SERVE_OVERLOADED"
+
+    def __init__(self, detail: str = ""):
+        super().__init__(f"{self.MARKER}: {detail}" if detail
+                         else self.MARKER)
+
+
+def is_overloaded_error(exc: BaseException) -> bool:
+    return isinstance(exc, OverloadedError) \
+        or OverloadedError.MARKER in f"{type(exc).__name__}{exc}"
+
+
+# Set by the replica wrapper in its own process just before it constructs
+# the user callable, so user code (e.g. the LLM engine tagging its
+# metrics per deployment) can learn its identity (ray parity:
+# serve.get_replica_context). None outside a replica.
+CURRENT_REPLICA_CONTEXT: Optional[Dict[str, str]] = None
+
+
+def get_replica_context() -> Optional[Dict[str, str]]:
+    """{"app", "deployment", "replica"} inside a serve replica, else
+    None."""
+    return CURRENT_REPLICA_CONTEXT
+
+
 @dataclass
 class Request:
     """HTTP request envelope delivered to ingress deployments."""
@@ -84,6 +116,11 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = field(
         default_factory=_default_graceful_shutdown_s
     )
+    # Prefix-affinity routing (LLM deployments): None = auto — handles
+    # bias p2c toward the replica holding the longest shared prefix
+    # whenever replicas report a prefix digest; False disables even
+    # then; True keeps the bias armed while digests are still empty.
+    prefix_affinity: Optional[bool] = None
 
     def replica_actor_options(self) -> Dict[str, Any]:
         opts = dict(self.ray_actor_options or {})

@@ -45,6 +45,10 @@ class _DeploymentState:
         # _private/router.py:262 replica queue-len probes)
         self.loads: Dict[str, float] = {}
         self.loads_ts: Optional[float] = None  # when loads were collected
+        # LLM engine ride-alongs from the same probe (queued sequences +
+        # prefix digest): replica_name -> report dict. Empty for plain
+        # deployments — get_replica_state stays byte-identical for them.
+        self.llm: Dict[str, dict] = {}
         self.target = config.num_replicas
         self.autoscaling = AutoscalingConfig.from_dict(
             config.autoscaling_config
@@ -193,11 +197,19 @@ class ServeController:
                 return {"names": [], "loads": {}, "loads_age_s": None}
             names = list(st.replicas.keys()) or list(st.draining.keys())
             loads_ts = getattr(st, "loads_ts", None)
-            return {
+            out = {
                 "names": names, "loads": dict(st.loads),
                 "loads_age_s": (time.time() - loads_ts)
                 if loads_ts is not None else None,
             }
+            # prefix digests ride only when replicas actually report
+            # them AND the deployment hasn't opted out — plain
+            # deployments get the exact legacy payload
+            llm = getattr(st, "llm", None)
+            if llm and getattr(st.config, "prefix_affinity", None) \
+                    is not False:
+                out["llm"] = {n: dict(r) for n, r in llm.items()}
+            return out
 
     def get_routes(self) -> Dict[str, tuple]:
         """route_prefix -> (app_name, ingress deployment)."""
@@ -397,26 +409,31 @@ class ServeController:
         for st in states:
             if not st.replicas:
                 st.loads = {}
+                st.llm = {}
                 continue
             for name, h in list(st.replicas.items()):
                 probes.append((st, name, h.get_metrics.remote()))
         if not probes:
             return
         new_loads: Dict[int, Dict[str, float]] = {}
+        new_llm: Dict[int, Dict[str, dict]] = {}
         deadline = time.time() + 10.0
         for st, name, ref in probes:
             loads = new_loads.setdefault(id(st), {})
+            llm = new_llm.setdefault(id(st), {})
             try:
                 remaining = max(0.1, deadline - time.time())
-                loads[name] = float(
-                    ray_tpu.get(ref, timeout=remaining)["ongoing"]
-                )
+                m = ray_tpu.get(ref, timeout=remaining)
+                loads[name] = float(m["ongoing"])
+                if isinstance(m.get("llm"), dict):
+                    llm[name] = m["llm"]
             except Exception:
                 loads[name] = float("inf")
         done_at = time.time()
         for st in states:
             if id(st) in new_loads:
                 st.loads = new_loads[id(st)]
+                st.llm = new_llm.get(id(st), {})
                 st.loads_ts = done_at  # freshness stamp the handles age
 
     def _autoscale_once(self):

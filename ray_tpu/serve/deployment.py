@@ -84,6 +84,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                user_config: Optional[Any] = None,
                health_check_period_s: float = 10.0,
                graceful_shutdown_timeout_s: Optional[float] = None,
+               prefix_affinity: Optional[bool] = None,
                **_ignored):
     """ray parity: @serve.deployment (serve/api.py:414)."""
 
@@ -102,6 +103,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             autoscaling_config=auto,
             user_config=user_config,
             health_check_period_s=health_check_period_s,
+            prefix_affinity=prefix_affinity,
             **({"graceful_shutdown_timeout_s": graceful_shutdown_timeout_s}
                if graceful_shutdown_timeout_s is not None else {}),
         )

@@ -290,6 +290,12 @@ class _RouterState:
         self.report_max_age_s = 5.0
         self.last_refresh = 0.0
         self.push_subscribed = False
+        # prefix-affinity state (LLM deployments): per-replica chain-
+        # hash digests + the block size they were computed with, from
+        # the controller's load report. Empty for plain deployments —
+        # pick() degenerates to exactly the legacy p2c then.
+        self.prefix_index: Dict[str, frozenset] = {}
+        self.prefix_block_tokens = 0
         self._setup_metrics()
 
     def _setup_metrics(self):
@@ -356,6 +362,14 @@ class _RouterState:
         age0 = state.get("loads_age_s")
         self.reported_age0 = float(age0) if age0 is not None else 0.0
         self.reported_at = now if age0 is not None else None
+        llm = state.get("llm") or {}
+        self.prefix_index = {
+            n: frozenset(r.get("prefix_digest") or ())
+            for n, r in llm.items() if n in dict(replicas)
+        }
+        self.prefix_block_tokens = max(
+            [int(r.get("block_tokens") or 0) for r in llm.values()],
+            default=0)
         try:
             from ray_tpu._private.config import GLOBAL_CONFIG
 
@@ -383,12 +397,69 @@ class _RouterState:
             else self.reported.get(name, 0.0)
         return reported + self.inflight.get(name, 0)
 
-    def pick(self):
-        """Power-of-two-choices on reported + local load."""
+    def request_chains(self, args, kwargs) -> list:
+        """Prefix chain hashes for a request, when this deployment is
+        prefix-affine (replicas reported digests) and the LLM path is
+        enabled. [] means: route plain p2c."""
+        if not self.prefix_index or self.prefix_block_tokens <= 0:
+            return []
+        try:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            if not GLOBAL_CONFIG.serve_llm_enabled:
+                return []
+            from ray_tpu.serve.llm import prefix as prefix_mod
+
+            tokens = prefix_mod.extract_tokens(args, kwargs)
+            if not tokens:
+                return []
+            return prefix_mod.chain_hashes(tokens,
+                                           self.prefix_block_tokens)
+        except Exception:
+            return []
+
+    def affinity_pick(self, chains) -> Optional[tuple]:
+        """The replica already holding the LONGEST shared prefix —
+        skipped (None) when nothing matches, when the load report is too
+        stale to trust (the digests rode the same report the staleness
+        guard ages), or when the winner is drowning (score beyond every
+        other replica's by more than a batch: affinity must not defeat
+        load balancing)."""
+        if not chains or self.reported_stale():
+            return None
+        from ray_tpu.serve.llm import prefix as prefix_mod
+
+        best, best_depth = None, 0
+        for rep in self.replicas:
+            held = self.prefix_index.get(rep[0])
+            if not held:
+                continue
+            depth = prefix_mod.longest_match_depth(chains, held)
+            if depth > best_depth or (
+                depth == best_depth and depth > 0
+                and best is not None
+                and self.score(rep[0]) < self.score(best[0])
+            ):
+                best, best_depth = rep, depth
+        if best is None:
+            return None
+        others = [self.score(n) for n, _ in self.replicas
+                  if n != best[0]]
+        if others and self.score(best[0]) > min(others) + best_depth + 1:
+            return None  # cache warmth doesn't pay for that much queue
+        return best
+
+    def pick(self, chains=None):
+        """Power-of-two-choices on reported + local load, with an
+        optional prefix-affinity bias (LLM deployments)."""
         if not self.replicas:
             raise RuntimeError(
                 f"no replicas for {self.app_name}/{self.deployment_name}"
             )
+        if chains:
+            best = self.affinity_pick(chains)
+            if best is not None:
+                return best
         if len(self.replicas) == 1:
             return self.replicas[0]
         a, b = random.sample(self.replicas, 2)
@@ -455,11 +526,15 @@ class DeploymentHandle:
         traced = reqtrace.is_enabled()
         rid = (self._rid or reqtrace.new_request_id()) if traced else ""
         last_err = None
+        chains = None  # prefix identity: computed once, after the first
+        # refresh has told us whether this deployment is prefix-affine
         while time.monotonic() < deadline:
             t_route = time.time()
             try:
                 st.refresh()
-                name, actor = st.pick()
+                if chains is None:
+                    chains = st.request_chains(args, kwargs)
+                name, actor = st.pick(chains)
             except Exception as e:  # controller not up yet / no replicas
                 last_err = e
                 time.sleep(0.1)

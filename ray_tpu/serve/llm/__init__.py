@@ -1,0 +1,37 @@
+"""LLM serving engine: continuous batching over an arena-paged KV cache.
+
+The three layers (vLLM's PagedAttention + Orca's iteration-level
+scheduling, rebuilt on this runtime's own substrates):
+
+- ``engine.SequenceScheduler`` admits sequences into the running batch at
+  decode-step boundaries (no drain barrier), with KV-budget-aware
+  admission control that sheds load as 503s before the replica wedges.
+- ``kv_cache.KVPool`` pages the KV cache into fixed-size slab-arena
+  entries leased from the node's raylet: a page is an ordinary object-
+  plane entry (memview row, leak verdict, dead-range/PUNCH_HOLE
+  reclamation) whose data region the engine appends into zero-copy.
+- ``prefix.chain_hashes`` is the radix-style prefix identity both the
+  replica's prefix cache and the handle's affinity router hash with, so
+  a request routes to the replica already holding its longest prefix.
+
+``LLMServer`` is the deployable ingress: an async-generator handler, so
+tokens stream through the existing replica stream protocol and the
+request observatory's first_byte/last_byte marks measure TTFT for free.
+"""
+
+from ray_tpu.serve.llm.engine import LLMServer, SequenceScheduler
+from ray_tpu.serve.llm.kv_cache import KVPool, KVPage, KV_PAGE_OID_PREFIX
+from ray_tpu.serve.llm.model import SyntheticLLM, load_model
+from ray_tpu.serve.llm.prefix import chain_hashes, longest_match_depth
+
+__all__ = [
+    "LLMServer",
+    "SequenceScheduler",
+    "KVPool",
+    "KVPage",
+    "KV_PAGE_OID_PREFIX",
+    "SyntheticLLM",
+    "load_model",
+    "chain_hashes",
+    "longest_match_depth",
+]

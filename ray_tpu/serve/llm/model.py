@@ -1,0 +1,120 @@
+"""Models the serving engine drives: synthetic for CPU CI, gpt2 behind a
+flag for real chips.
+
+``SyntheticLLM`` is an LLM-shaped prefill+decode function, not a toy
+sleep loop: each token's KV vector is a deterministic function of
+(token, position), and each decoded token is a deterministic function of
+the KV CONTENTS the sequence's block table points at. That makes prefix-
+cache correctness assertable — a sequence served from cached pages must
+emit byte-identical tokens to one that prefilled from scratch, because
+any difference in reused page bytes changes the output. ``step_delay_s``
+models the per-STEP (not per-sequence) forward cost, which is exactly
+the economics continuous batching exploits.
+
+The real model path (``serve_llm_real_model=1``) adapts
+``models/gpt2.py``: prefill runs the transformer over the prompt, decode
+re-runs over the growing sequence (no in-graph KV threading yet — the
+ROADMAP's "real gpt2-on-TPU serving" remainder). It is import-gated so
+CPU CI never touches jax through the serving path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+VOCAB = 50_257  # gpt2-sized token space
+
+
+class SyntheticLLM:
+    """Deterministic prefill/decode over externally-paged KV."""
+
+    def __init__(self, kv_dim: int = 64, step_delay_s: float = 0.0):
+        self.kv_dim = int(kv_dim)
+        self.step_delay_s = float(step_delay_s)
+        # fixed projection the KV "content hash" is read through, so the
+        # next-token function depends on every float of every page
+        rng = np.random.default_rng(1234)
+        self._probe = rng.standard_normal(self.kv_dim).astype(np.float32)
+
+    def kv_vec(self, token: int, pos: int) -> np.ndarray:
+        """KV for one (token, position): cheap, deterministic, and
+        position-mixed so reusing a page at the wrong depth corrupts the
+        output (which a test would catch)."""
+        base = (int(token) * 2654435761 + pos * 40503) & 0xFFFFFFFF
+        idx = np.arange(self.kv_dim, dtype=np.float32)
+        return ((base % 977) / 977.0 + idx * 1e-3).astype(np.float32)
+
+    def step_cost(self, batch_size: int):
+        """One decode step's forward pass for the whole running batch."""
+        if self.step_delay_s > 0:
+            time.sleep(self.step_delay_s)
+
+    def next_token(self, kv_views: Sequence[np.ndarray], n_tokens: int) -> int:
+        """Greedy 'sampling': a hash of the attended KV state. Reads the
+        actual page bytes (float32 sums in block order are
+        deterministic), so stale/corrupt/missing pages change the
+        output."""
+        acc = 0.0
+        for v in kv_views:
+            acc += float(np.dot(v.reshape(-1, self.kv_dim).sum(axis=0),
+                                self._probe))
+        return int(abs(int(acc * 1e4)) + n_tokens * 31) % VOCAB
+
+
+class GPT2LLM:
+    """Real-model adapter (flag-gated): greedy decode by full re-forward
+    per step. Correct but O(n^2) — in-graph paged attention over the
+    arena KV is the named follow-up."""
+
+    def __init__(self, step_delay_s: float = 0.0, **config_kwargs):
+        import jax
+
+        from ray_tpu.models import gpt2
+
+        cfg = gpt2.GPT2Config.small_test(**config_kwargs) \
+            if hasattr(gpt2.GPT2Config, "small_test") else gpt2.GPT2Config()
+        self._model, self._params = gpt2.init_params(
+            cfg, jax.random.PRNGKey(0))
+        self.kv_dim = cfg.n_embd
+        self.step_delay_s = float(step_delay_s)
+        self._jax = jax
+
+    def kv_vec(self, token: int, pos: int) -> np.ndarray:
+        # the adapter does not thread external KV into the graph yet;
+        # pages still hold a deterministic per-token record so paging,
+        # routing, and reclamation exercise the identical machinery
+        base = (int(token) * 2654435761 + pos * 40503) & 0xFFFFFFFF
+        idx = np.arange(self.kv_dim, dtype=np.float32)
+        return ((base % 977) / 977.0 + idx * 1e-3).astype(np.float32)
+
+    def step_cost(self, batch_size: int):
+        if self.step_delay_s > 0:
+            time.sleep(self.step_delay_s)
+
+    def forward_next(self, tokens: List[int]) -> int:
+        import jax.numpy as jnp
+
+        ids = jnp.asarray([tokens], dtype=jnp.int32)
+        logits = self._model.apply({"params": self._params}, ids)
+        return int(jnp.argmax(logits[0, -1]))
+
+    def next_token(self, kv_views, n_tokens: int, tokens=None) -> int:
+        if tokens is not None:
+            return self.forward_next(list(tokens))
+        return 0
+
+
+def load_model(kv_dim: int = 64, step_delay_s: float = 0.0):
+    """Model factory the deployment uses: synthetic unless the real-model
+    flag is armed (and jax is importable on this node)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if getattr(GLOBAL_CONFIG, "serve_llm_real_model", False):
+        try:
+            return GPT2LLM(step_delay_s=step_delay_s)
+        except Exception:
+            pass  # no jax/chips here: synthetic keeps the replica serving
+    return SyntheticLLM(kv_dim=kv_dim, step_delay_s=step_delay_s)

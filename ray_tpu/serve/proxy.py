@@ -555,7 +555,16 @@ class HTTPProxy:
 
         try:
             result = await loop.run_in_executor(self._pool, call)
-        except Exception as e:  # noqa: BLE001 — surface as 500
+        except Exception as e:  # noqa: BLE001 — surface as 500/503
+            from ray_tpu.serve._common import is_overloaded_error
+
+            if is_overloaded_error(e):
+                # typed load-shed from admission control (LLM engine
+                # bounded queue / KV budget): 503 tells the client this
+                # is transient backpressure, not a broken handler
+                return web.Response(
+                    status=503, headers={"Retry-After": "1"},
+                    text=f"{type(e).__name__}: {e}"), app_name
             return web.Response(status=500,
                                 text=f"{type(e).__name__}: {e}"), app_name
         if isinstance(result, dict) and STREAM_MARKER in result:
@@ -608,27 +617,42 @@ class HTTPProxy:
 
         from ray_tpu._private import reqtrace
 
+        from ray_tpu.serve._common import SERVE_NAMESPACE, \
+            is_overloaded_error
+
+        replica = ray_tpu.get_actor(info["replica"],
+                                    namespace=SERVE_NAMESPACE)
+        sid = info["stream_id"]
+        loop = asyncio.get_running_loop()
+
+        def _fetch():
+            return ray_tpu.get(replica.next_chunks.remote(sid), timeout=60)
+
+        # first batch BEFORE committing status/headers: a generator that
+        # fails before its first yield (admission shed, bad request) must
+        # surface as a real 503/500, not a 200 with an error marker
+        try:
+            items, done = await loop.run_in_executor(self._pool, _fetch)
+        except Exception as e:  # noqa: BLE001
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+            if is_overloaded_error(e):
+                return web.Response(status=503,
+                                    headers={"Retry-After": "1"},
+                                    text=f"{type(e).__name__}: {e}")
+            return web.Response(status=500,
+                                text=f"{type(e).__name__}: {e}")
         resp = web.StreamResponse()
         resp.headers["Content-Type"] = "text/plain; charset=utf-8"
         if rid:
             resp.headers["x-request-id"] = rid
         resp.enable_chunked_encoding()
         await resp.prepare(request)
-        from ray_tpu.serve._common import SERVE_NAMESPACE
-
-        replica = ray_tpu.get_actor(info["replica"],
-                                    namespace=SERVE_NAMESPACE)
-        sid = info["stream_id"]
-        loop = asyncio.get_running_loop()
         first_byte_sent = False
         try:
             while True:
-                items, done = await loop.run_in_executor(
-                    self._pool,
-                    lambda: ray_tpu.get(
-                        replica.next_chunks.remote(sid), timeout=60
-                    ),
-                )
                 for item in items:
                     if isinstance(item, bytes):
                         chunk = item
@@ -645,6 +669,8 @@ class HTTPProxy:
                             replica=info.get("replica") or "")
                 if done:
                     break
+                items, done = await loop.run_in_executor(
+                    self._pool, _fetch)
         except Exception as e:  # noqa: BLE001 — mid-stream failure
             # headers are gone; best we can do is terminate with a marker
             try:

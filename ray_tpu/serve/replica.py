@@ -70,6 +70,11 @@ class Replica:
         self._stream_ids = itertools.count()
         self._streams_lock = threading.Lock()
         if inspect.isclass(cls_or_fn):
+            from ray_tpu.serve import _common
+            _common.CURRENT_REPLICA_CONTEXT = {
+                "app": app, "deployment": deployment,
+                "replica": replica_name or "",
+            }
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_function = False
         else:
@@ -97,10 +102,11 @@ class Replica:
                       "Requests in flight inside the replica"
                       ).labels(**tags).set_fn(lambda: self._ongoing)
             reg.gauge("serve_replica_queue_depth",
-                      "Requests admitted to the replica but not yet in "
-                      "user code (pool backlog)"
+                      "Work queued in the replica: pool backlog, or the "
+                      "user callable's own queue (e.g. queued sequences "
+                      "on an LLM replica) via __serve_queue_depth__"
                       ).labels(replica=self._name or "?", **tags
-                               ).set_fn(lambda: self._queued)
+                               ).set_fn(lambda: self._queue_depth())
             reg.gauge("serve_replica_total_requests",
                       "Requests handled by the replica (monotonic)"
                       ).labels(**tags).set_fn(lambda: self._total)
@@ -120,8 +126,39 @@ class Replica:
             fn()
         return True
 
+    def _queue_depth(self) -> int:
+        """Queued work. A callable exposing ``__serve_queue_depth__``
+        (the LLM engine does) overrides the HTTP pool backlog: a
+        streaming LLM replica holds ~0 unstarted requests while its
+        sequence queue is deep — autoscaling and routing must see the
+        sequences, not the empty pool."""
+        hook = getattr(self._callable, "__serve_queue_depth__", None)
+        if hook is not None:
+            try:
+                return int(hook())
+            except Exception:
+                pass
+        return self._queued
+
     def get_metrics(self) -> Dict[str, float]:
-        return {"ongoing": self._ongoing, "total": self._total}
+        out = {"ongoing": self._ongoing, "total": self._total}
+        # LLM engine ride-along (sequence load + prefix digest for the
+        # affinity router); plain callables return the legacy dict
+        # byte-identically
+        hook = getattr(self._callable, "__serve_llm_report__", None)
+        if hook is not None:
+            try:
+                report = hook()
+                out["llm"] = report
+                # sequence load is the meaningful routing/autoscaling
+                # signal for an engine replica: streams in flight all
+                # look "ongoing" even when the batch is full
+                out["ongoing"] = float(
+                    report.get("running_seqs", 0)
+                    + report.get("queued_seqs", 0)) or out["ongoing"]
+            except Exception:
+                pass
+        return out
 
     def prepare_shutdown(self, timeout_s: float = 5.0) -> bool:
         """Drain: wait for ongoing requests to finish."""

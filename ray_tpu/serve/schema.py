@@ -38,6 +38,9 @@ class DeploymentSchema:
     ray_actor_options: Optional[Dict[str, Any]] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Optional[Any] = None
+    # None = auto (router uses prefix-affinity when the replica reports an
+    # LLM prefix digest), False = always plain p2c, True = force-enable
+    prefix_affinity: Optional[bool] = None
 
     def to_dict(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -116,6 +119,7 @@ def build(app, name: str = "default") -> dict:
             ray_actor_options=cfg.ray_actor_options,
             autoscaling_config=cfg.autoscaling_config,
             user_config=cfg.user_config,
+            prefix_affinity=getattr(cfg, "prefix_affinity", None),
         ).to_dict())
     return {
         "name": name,

@@ -73,6 +73,14 @@ if [ "$rc" -ne 0 ]; then
         echo "--- object-plane gauges (arena occupancy + punch yield) ---" >&2
         grep -aE 'slab_arena_(dead|live)_bytes|slab_arena_fragmentation|slab_arena_punched|slab_punch|slab_segments_pinned|object_store_slab_rx_assemblies' \
             "$out" >&2 || true
+        # LLM-serving triage: KV page-state gauges make leaked decode
+        # pages visible after a replica kill (active pages on a dead
+        # replica should have become dead ranges, not stuck "active"),
+        # and a collapsed hit rate after re-formation fingers the prefix
+        # cache rather than the scheduler
+        echo "--- LLM serving KV gauges (page states + prefix hit rate) ---" >&2
+        grep -aE 'kv_cache_pages|kv_cache_hit_rate|serve_llm_(tokens_total|shed_total|batch_size)' \
+            "$out" >&2 || true
     else
         echo "(no live cluster to scrape)" >&2
     fi
